@@ -1,0 +1,10 @@
+"""narwhal-tpu — a TPU-native Narwhal (DAG mempool) + Tusk (BFT consensus) framework.
+
+Built from scratch against the structural blueprint in SURVEY.md (reference:
+asonnino/narwhal, a Rust workspace).  The compute-heavy per-round loops
+(batched ed25519 verification, message digesting, Tusk DAG ordering) run on
+TPU via JAX; the host runtime (networking, storage, actor pipelines) is
+asyncio + native C++ helpers.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,39 @@
+"""Identity layer: digests, ed25519 keys/signatures, signature service.
+
+Mirrors the capability surface of the reference `crypto` crate
+(reference crypto/src/lib.rs): a 32-byte `Digest` newtype, a `Hash` seam
+(here: objects expose `.digest()`), ed25519 keypairs, single `verify` and
+batched `verify_batch`, and a `SignatureService` that serializes signing.
+
+This module is also the backend seam for TPU execution: `set_backend("tpu")`
+routes `verify_batch` through the JAX batched-verification kernel in
+`narwhal_tpu.ops.ed25519` (reference's per-certificate
+`Signature::verify_batch`, crypto/src/lib.rs:206-219, is the #1 crypto hot
+loop per SURVEY.md §3.3).
+"""
+
+from .digest import Digest, sha512_digest
+from .keys import KeyPair, PublicKey, SecretKey, Signature
+from .service import SignatureService
+from .backend import (
+    set_backend,
+    get_backend,
+    verify,
+    verify_batch,
+    verify_batch_mask,
+)
+
+__all__ = [
+    "Digest",
+    "sha512_digest",
+    "KeyPair",
+    "PublicKey",
+    "SecretKey",
+    "Signature",
+    "SignatureService",
+    "set_backend",
+    "get_backend",
+    "verify",
+    "verify_batch",
+    "verify_batch_mask",
+]

@@ -1,0 +1,86 @@
+"""Pluggable verification backend: CPU reference vs. TPU batched kernel.
+
+The reference's `Signature::verify_batch` (crypto/src/lib.rs:206-219) is the
+per-round crypto hot spot — 2f+1 ed25519 verifications per certificate × N
+certificates per round (SURVEY.md §3.3).  Here that call is a seam: the CPU
+backend loops over OpenSSL verifies; the TPU backend ships the whole batch to
+a vmapped JAX verifier (narwhal_tpu/ops/ed25519.py) in one dispatch.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+from .digest import Digest
+from .keys import PublicKey, Signature, cpu_verify
+
+
+class CpuBackend:
+    name = "cpu"
+
+    def verify(self, message: bytes, key: PublicKey, sig: Signature) -> bool:
+        return cpu_verify(message, key, sig)
+
+    def verify_batch_mask(
+        self,
+        messages: Sequence[bytes],
+        keys: Sequence[PublicKey],
+        sigs: Sequence[Signature],
+    ) -> List[bool]:
+        return [cpu_verify(m, k, s) for m, k, s in zip(messages, keys, sigs)]
+
+
+_backend = CpuBackend()
+
+
+def set_backend(name: str) -> None:
+    """Select the verification backend: "cpu" or "tpu"."""
+    global _backend
+    if name == "cpu":
+        _backend = CpuBackend()
+    elif name == "tpu":
+        try:
+            from ..ops.ed25519 import TpuBackend  # deferred: JAX import is heavy
+        except ImportError as e:
+            raise NotImplementedError(
+                "TPU crypto backend requires narwhal_tpu.ops.ed25519 "
+                f"(import failed: {e})"
+            ) from e
+        _backend = TpuBackend()
+    else:
+        raise ValueError(f"unknown crypto backend {name!r}")
+
+
+def get_backend():
+    return _backend
+
+
+def verify(message: bytes, key: PublicKey, sig: Signature) -> bool:
+    return _backend.verify(message, key, sig)
+
+
+def verify_batch_mask(
+    messages: Sequence[bytes],
+    keys: Sequence[PublicKey],
+    sigs: Sequence[Signature],
+) -> List[bool]:
+    """Per-item validity mask for a batch of (message, key, signature)."""
+    if not (len(messages) == len(keys) == len(sigs)):
+        raise ValueError("verify_batch: length mismatch")
+    if not messages:
+        return []
+    return list(_backend.verify_batch_mask(messages, keys, sigs))
+
+
+def verify_batch(
+    digest: Digest,
+    keys: Sequence[PublicKey],
+    sigs: Sequence[Signature],
+) -> bool:
+    """All-or-nothing batch verification of many signatures over ONE digest —
+    the certificate-quorum check (reference primary/src/messages.rs:189-215).
+    An empty batch is invalid: a zero-signature certificate must never pass."""
+    if not keys:
+        return False
+    msgs = [bytes(digest)] * len(keys)
+    return all(verify_batch_mask(msgs, keys, sigs))

@@ -1,0 +1,36 @@
+"""32-byte digest newtype and the canonical protocol hash.
+
+Every protocol message hashes with SHA-512 truncated to 32 bytes, exactly as
+the reference does for batches, headers, votes and certificates (reference
+worker/src/processor.rs:35, primary/src/messages.rs:70-84).
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+
+DIGEST_LEN = 32
+
+
+class Digest(bytes):
+    """32-byte content digest. Subclasses bytes: hashable, ordered, compact."""
+
+    __slots__ = ()
+
+    def __new__(cls, b: bytes) -> "Digest":
+        if len(b) != DIGEST_LEN:
+            raise ValueError(f"Digest must be {DIGEST_LEN} bytes, got {len(b)}")
+        return super().__new__(cls, b)
+
+    @classmethod
+    def zero(cls) -> "Digest":
+        return cls(bytes(DIGEST_LEN))
+
+    def __repr__(self) -> str:  # short base64 like the reference's Debug impl
+        return base64.b64encode(self).decode()[:16]
+
+
+def sha512_digest(data: bytes) -> Digest:
+    """SHA-512 truncated to 32 bytes — the protocol-wide hash function."""
+    return Digest(hashlib.sha512(data).digest()[:DIGEST_LEN])

@@ -1,0 +1,140 @@
+"""ed25519 keys and signatures (CPU reference implementation).
+
+Mirrors reference crypto/src/lib.rs:64-220: `PublicKey`/`SecretKey` newtypes
+with base64 serialization, deterministic keygen from a seeded RNG for test
+fixtures, and 64-byte signatures.  The CPU implementation rides the
+`cryptography` package (OpenSSL ed25519); the TPU batched verifier lives in
+`narwhal_tpu.ops.ed25519` behind `crypto.backend`.
+"""
+
+from __future__ import annotations
+
+import base64
+from typing import Optional
+
+from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+    Ed25519PrivateKey,
+    Ed25519PublicKey,
+)
+from cryptography.exceptions import InvalidSignature
+
+from .digest import Digest
+
+
+class PublicKey(bytes):
+    """32-byte ed25519 public key."""
+
+    __slots__ = ()
+
+    def __new__(cls, b: bytes) -> "PublicKey":
+        if len(b) != 32:
+            raise ValueError(f"PublicKey must be 32 bytes, got {len(b)}")
+        return super().__new__(cls, b)
+
+    @classmethod
+    def default(cls) -> "PublicKey":
+        return cls(bytes(32))
+
+    def encode_base64(self) -> str:
+        return base64.b64encode(self).decode()
+
+    @classmethod
+    def decode_base64(cls, s: str) -> "PublicKey":
+        return cls(base64.b64decode(s))
+
+    def __repr__(self) -> str:
+        return self.encode_base64()[:16]
+
+
+class SecretKey(bytes):
+    """32-byte ed25519 secret seed."""
+
+    __slots__ = ()
+
+    def __new__(cls, b: bytes) -> "SecretKey":
+        if len(b) != 32:
+            raise ValueError(f"SecretKey must be 32 bytes, got {len(b)}")
+        return super().__new__(cls, b)
+
+    def encode_base64(self) -> str:
+        return base64.b64encode(self).decode()
+
+    @classmethod
+    def decode_base64(cls, s: str) -> "SecretKey":
+        return cls(base64.b64decode(s))
+
+
+class Signature(bytes):
+    """64-byte ed25519 signature (R || S)."""
+
+    __slots__ = ()
+
+    def __new__(cls, b: bytes) -> "Signature":
+        if len(b) != 64:
+            raise ValueError(f"Signature must be 64 bytes, got {len(b)}")
+        return super().__new__(cls, b)
+
+    @classmethod
+    def default(cls) -> "Signature":
+        # An all-zero signature (never valid); used for unsigned placeholders
+        # the way the reference uses Signature::default() in tests.
+        return cls(bytes(64))
+
+    def encode_base64(self) -> str:
+        return base64.b64encode(self).decode()
+
+
+class KeyPair:
+    """An ed25519 identity: public name + secret seed.
+
+    Reference config/src/lib.rs:249-271 (KeyPair with JSON import/export).
+    """
+
+    __slots__ = ("name", "secret", "_sk")
+
+    def __init__(self, name: PublicKey, secret: SecretKey) -> None:
+        self.name = name
+        self.secret = secret
+        self._sk = Ed25519PrivateKey.from_private_bytes(secret)
+
+    @classmethod
+    def generate(cls, rng_seed: Optional[bytes] = None) -> "KeyPair":
+        """Generate a keypair; pass a 32-byte seed for deterministic fixtures
+        (the reference tests seed StdRng with [0;32],
+        reference primary/src/tests/common.rs:29-32)."""
+        if rng_seed is None:
+            sk = Ed25519PrivateKey.generate()
+            seed = sk.private_bytes_raw()
+        else:
+            if len(rng_seed) != 32:
+                raise ValueError("seed must be 32 bytes")
+            seed = rng_seed
+            sk = Ed25519PrivateKey.from_private_bytes(seed)
+        pk = sk.public_key().public_bytes_raw()
+        return cls(PublicKey(pk), SecretKey(seed))
+
+    def sign(self, digest: Digest) -> Signature:
+        return Signature(self._sk.sign(bytes(digest)))
+
+    # --- JSON file import/export (reference config/src/lib.rs:28-56) ---
+
+    def to_json(self) -> dict:
+        return {"name": self.name.encode_base64(), "secret": self.secret.encode_base64()}
+
+    @classmethod
+    def from_json(cls, obj: dict) -> "KeyPair":
+        return cls(
+            PublicKey.decode_base64(obj["name"]),
+            SecretKey.decode_base64(obj["secret"]),
+        )
+
+
+def cpu_verify(message: bytes, key: PublicKey, signature: Signature) -> bool:
+    """Single strict-ish verification via OpenSSL."""
+    try:
+        Ed25519PublicKey.from_public_bytes(bytes(key)).verify(
+            bytes(signature), bytes(message)
+        )
+        return True
+    except (InvalidSignature, ValueError):
+        return False

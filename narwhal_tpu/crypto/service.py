@@ -1,0 +1,61 @@
+"""SignatureService: an actor that owns the secret key and serializes signing.
+
+Reference crypto/src/lib.rs:224-250 — callers send a digest over a channel
+and receive the signature via oneshot.  In asyncio terms, a queue-fed task
+resolving futures; callers `await service.request_signature(digest)`.
+Serializing through one task keeps the secret key in one place and gives the
+TPU build a natural batching point for outbound signing.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional, Tuple
+
+from .digest import Digest
+from .keys import KeyPair, Signature
+
+
+class SignatureService:
+    def __init__(self, keypair: KeyPair) -> None:
+        self._keypair = keypair
+        self._queue: Optional[asyncio.Queue] = None
+        self._task: Optional[asyncio.Task] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+
+    def _ensure_started(self) -> None:
+        loop = asyncio.get_running_loop()
+        # Re-arm if never started, the task died, or we moved to a new loop
+        # (e.g. successive asyncio.run calls in tests).
+        if self._task is None or self._task.done() or self._loop is not loop:
+            self._queue = asyncio.Queue()
+            self._loop = loop
+            self._task = loop.create_task(self._run(self._queue))
+
+    async def _run(self, queue: asyncio.Queue) -> None:
+        while True:
+            digest, fut = await queue.get()
+            if fut.cancelled():
+                continue
+            try:
+                fut.set_result(self._keypair.sign(digest))
+            except Exception as e:  # propagate instead of wedging the actor
+                fut.set_exception(e)
+
+    async def request_signature(self, digest: Digest) -> Signature:
+        self._ensure_started()
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        item: Tuple[Digest, asyncio.Future] = (digest, fut)
+        await self._queue.put(item)
+        return await fut
+
+    def sign_now(self, digest: Digest) -> Signature:
+        """Synchronous signing for non-async contexts (tests, tools)."""
+        return self._keypair.sign(digest)
+
+    def close(self) -> None:
+        if self._task is not None and not self._task.done():
+            self._task.cancel()
+        self._task = None
+        self._queue = None
+        self._loop = None

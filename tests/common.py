@@ -1,0 +1,50 @@
+"""Deterministic test fixtures, modeled on the reference's shared fixtures
+(reference primary/src/tests/common.rs: seeded RNG keys, canonical 4-authority
+committee with stake 1 and sequential localhost ports, committee_with_base_port
+so concurrent tests don't collide)."""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from narwhal_tpu.config import (
+    Authority,
+    Committee,
+    PrimaryAddresses,
+    WorkerAddresses,
+)
+from narwhal_tpu.crypto import KeyPair, PublicKey
+
+
+def keys(n: int = 4) -> List[KeyPair]:
+    """Deterministic keypairs from fixed seeds (analog of StdRng::from_seed)."""
+    return [KeyPair.generate(bytes([i]) * 32) for i in range(n)]
+
+
+def committee(base_port: int = 0, n: int = 4, workers: int = 1) -> Committee:
+    """Canonical committee: stake 1 each, sequential 127.0.0.1 ports.
+
+    With base_port=0 every address gets port 0 — fine for tests that never
+    dial (consensus, aggregators); pass a distinct real base per test file
+    that opens sockets, like the reference does.
+    """
+    authorities: Dict[PublicKey, Authority] = {}
+    port = base_port
+    for kp in keys(n):
+        def addr() -> str:
+            nonlocal port
+            a = f"127.0.0.1:{port}"
+            if base_port != 0:
+                port += 1
+            return a
+
+        primary = PrimaryAddresses(
+            primary_to_primary=addr(), worker_to_primary=addr()
+        )
+        ws: Dict[int, WorkerAddresses] = {}
+        for wid in range(workers):
+            ws[wid] = WorkerAddresses(
+                transactions=addr(), worker_to_worker=addr(), primary_to_worker=addr()
+            )
+        authorities[kp.name] = Authority(stake=1, primary=primary, workers=ws)
+    return Committee(authorities)

@@ -1,0 +1,48 @@
+import os
+
+from narwhal_tpu.config import Parameters
+from tests.common import committee, keys
+
+
+def test_quorum_math():
+    c = committee()
+    assert c.total_stake() == 4
+    assert c.quorum_threshold() == 3  # 2f+1 with n=4, f=1
+    assert c.validity_threshold() == 2  # f+1
+
+
+def test_quorum_math_large():
+    c = committee(n=10)
+    assert c.quorum_threshold() == 7
+    assert c.validity_threshold() == 4
+    c = committee(n=50)
+    assert c.quorum_threshold() == 34
+    assert c.validity_threshold() == 17
+
+
+def test_address_lookups():
+    c = committee(base_port=6000, workers=2)
+    me = keys()[0].name
+    assert len(c.others_primaries(me)) == 3
+    assert len(c.our_workers(me)) == 2
+    others = c.others_workers(me, 1)
+    assert len(others) == 3
+    assert all(name != me for name, _ in others)
+
+
+def test_committee_json_roundtrip(tmp_path):
+    c = committee(base_port=6100, workers=2)
+    path = os.path.join(tmp_path, "committee.json")
+    c.export(path)
+    c2 = type(c).load(path)
+    assert c2.to_json() == c.to_json()
+    assert c2.quorum_threshold() == c.quorum_threshold()
+
+
+def test_parameters_roundtrip(tmp_path):
+    p = Parameters(header_size=32, max_header_delay=50)
+    path = os.path.join(tmp_path, "parameters.json")
+    p.export(path)
+    p2 = Parameters.load(path)
+    assert p2 == p
+    assert p2.gc_depth == 50

@@ -1,0 +1,80 @@
+"""Analog of reference crypto/src/tests/crypto_tests.rs: sig round-trip,
+invalid sig, valid/invalid batch verification, SignatureService."""
+
+import asyncio
+
+from narwhal_tpu.crypto import (
+    Digest,
+    KeyPair,
+    Signature,
+    SignatureService,
+    sha512_digest,
+    verify,
+    verify_batch,
+    verify_batch_mask,
+)
+
+
+def test_digest():
+    d = sha512_digest(b"hello")
+    assert len(d) == 32
+    assert d == sha512_digest(b"hello")
+    assert d != sha512_digest(b"world")
+
+
+def test_deterministic_keygen():
+    a = KeyPair.generate(bytes(32))
+    b = KeyPair.generate(bytes(32))
+    assert a.name == b.name and a.secret == b.secret
+
+
+def test_import_export():
+    kp = KeyPair.generate(bytes([1]) * 32)
+    kp2 = KeyPair.from_json(kp.to_json())
+    assert kp2.name == kp.name and kp2.secret == kp.secret
+
+
+def test_verify_valid_signature():
+    kp = KeyPair.generate(bytes([2]) * 32)
+    d = sha512_digest(b"Hello, world!")
+    sig = kp.sign(d)
+    assert verify(bytes(d), kp.name, sig)
+
+
+def test_verify_invalid_signature():
+    kp = KeyPair.generate(bytes([2]) * 32)
+    d = sha512_digest(b"Hello, world!")
+    bad = sha512_digest(b"tampered")
+    sig = kp.sign(d)
+    assert not verify(bytes(bad), kp.name, sig)
+    assert not verify(bytes(d), kp.name, Signature.default())
+
+
+def test_verify_valid_batch():
+    d = sha512_digest(b"Hello, batch!")
+    kps = [KeyPair.generate(bytes([i]) * 32) for i in range(5)]
+    sigs = [kp.sign(d) for kp in kps]
+    assert verify_batch(d, [kp.name for kp in kps], sigs)
+
+
+def test_verify_invalid_batch():
+    d = sha512_digest(b"Hello, batch!")
+    kps = [KeyPair.generate(bytes([i]) * 32) for i in range(5)]
+    sigs = [kp.sign(d) for kp in kps]
+    sigs[2] = Signature.default()
+    assert not verify_batch(d, [kp.name for kp in kps], sigs)
+    mask = verify_batch_mask(
+        [bytes(d)] * 5, [kp.name for kp in kps], sigs
+    )
+    assert mask == [True, True, False, True, True]
+
+
+def test_signature_service():
+    async def go():
+        kp = KeyPair.generate(bytes([3]) * 32)
+        service = SignatureService(kp)
+        d = sha512_digest(b"service")
+        sig = await service.request_signature(d)
+        assert verify(bytes(d), kp.name, sig)
+
+    asyncio.run(go())

@@ -1,0 +1,39 @@
+from narwhal_tpu.utils.serde import Reader, Writer
+
+
+def test_roundtrip():
+    w = Writer()
+    w.u8(7).u32(1_000_000).u64(2**50).bytes(b"hello").raw(b"\x01\x02")
+    buf = w.finish()
+    r = Reader(buf)
+    assert r.u8() == 7
+    assert r.u32() == 1_000_000
+    assert r.u64() == 2**50
+    assert r.bytes() == b"hello"
+    assert r.raw(2) == b"\x01\x02"
+    r.expect_done()
+
+
+def test_underrun():
+    r = Reader(b"\x01")
+    try:
+        r.u32()
+        assert False
+    except ValueError:
+        pass
+
+
+def test_trailing_detected():
+    r = Reader(b"\x01\x02")
+    r.u8()
+    try:
+        r.expect_done()
+        assert False
+    except ValueError:
+        pass
+
+
+def test_deterministic():
+    a = Writer().u64(5).bytes(b"x").finish()
+    b = Writer().u64(5).bytes(b"x").finish()
+    assert a == b
