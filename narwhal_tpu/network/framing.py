@@ -1,0 +1,58 @@
+"""Length-delimited framing: u32 little-endian length prefix + payload.
+
+The reference uses tokio-util's LengthDelimitedCodec (4-byte prefix) over
+TCP — reference network/src/receiver.rs:70, simple_sender.rs:107.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+
+_LEN = struct.Struct("<I")
+
+# Batches are ≤ ~500 kB; headers/certs are tiny. 32 MiB is a generous cap
+# that still rejects garbage/hostile length prefixes.
+MAX_FRAME = 32 * 1024 * 1024
+
+
+class FrameError(Exception):
+    pass
+
+
+async def read_frame(reader: asyncio.StreamReader) -> bytes:
+    hdr = await reader.readexactly(_LEN.size)
+    (n,) = _LEN.unpack(hdr)
+    if n > MAX_FRAME:
+        raise FrameError(f"frame of {n} bytes exceeds cap {MAX_FRAME}")
+    if n == 0:
+        return b""
+    return await reader.readexactly(n)
+
+
+def frame(data: bytes) -> bytes:
+    return _LEN.pack(len(data)) + data
+
+
+async def write_frame(writer: asyncio.StreamWriter, data: bytes) -> None:
+    if len(data) > MAX_FRAME:
+        # Enforced on write too: an oversized frame would otherwise make the
+        # receiver kill the connection and a reliable sender retransmit the
+        # same poison frame in a hot loop.
+        raise FrameError(f"refusing to send {len(data)}-byte frame (cap {MAX_FRAME})")
+    writer.write(_LEN.pack(len(data)))
+    writer.write(data)
+    await writer.drain()
+
+
+def parse_address(addr: str):
+    host, _, port = addr.rpartition(":")
+    return host, int(port)
+
+
+def sample_peers(addresses, nodes: int):
+    """Pick `nodes` distinct random peers for lucky_broadcast."""
+    import random
+
+    addrs = list(addresses)
+    return random.sample(addrs, min(nodes, len(addrs)))

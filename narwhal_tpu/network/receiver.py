@@ -1,0 +1,81 @@
+"""TCP server: accept loop, one task per inbound connection, frames
+dispatched to a `MessageHandler` which may write replies/ACKs back on the
+same socket (reference network/src/receiver.rs:18-47).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Protocol
+
+from .framing import FrameError, parse_address, read_frame, write_frame
+
+log = logging.getLogger(__name__)
+
+
+class Writer:
+    """Reply channel handed to the handler: writes frames back to the peer."""
+
+    __slots__ = ("_writer",)
+
+    def __init__(self, writer: asyncio.StreamWriter) -> None:
+        self._writer = writer
+
+    async def send(self, data: bytes) -> None:
+        await write_frame(self._writer, data)
+
+
+class MessageHandler(Protocol):
+    async def dispatch(self, writer: Writer, message: bytes) -> None: ...
+
+
+class Receiver:
+    """Binds `address` and dispatches every inbound frame to `handler`."""
+
+    def __init__(self, address: str, handler: MessageHandler) -> None:
+        self.address = address
+        self.handler = handler
+        self._server: asyncio.AbstractServer | None = None
+
+    @classmethod
+    async def spawn(cls, address: str, handler: MessageHandler) -> "Receiver":
+        self = cls(address, handler)
+        host, port = parse_address(address)
+        self._server = await asyncio.start_server(self._on_connection, host, port)
+        log.debug("Listening on %s", address)
+        return self
+
+    @property
+    def port(self) -> int:
+        """Actual bound port (useful when spawned with port 0)."""
+        assert self._server is not None
+        return self._server.sockets[0].getsockname()[1]
+
+    async def _on_connection(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        peer = writer.get_extra_info("peername")
+        w = Writer(writer)
+        try:
+            while True:
+                message = await read_frame(reader)
+                await self.handler.dispatch(w, message)
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass  # peer closed
+        except FrameError as e:
+            log.warning("Bad frame from %s: %s", peer, e)
+        except Exception:
+            log.exception("Handler error for peer %s", peer)
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+    async def shutdown(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None

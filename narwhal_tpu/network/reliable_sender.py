@@ -1,0 +1,173 @@
+"""At-least-once sender with per-message delivery futures.
+
+Reference network/src/reliable_sender.rs (248 LoC): every `send` returns a
+`CancelHandler` — a future that resolves when the peer ACKs the message.
+Un-ACKed messages are retransmitted across reconnects with exponential
+backoff (200 ms ×2, capped 60 s; reliable_sender.rs:119,141-181), and the
+caller abandons delivery by cancelling the future (dropping the handler,
+reliable_sender.rs:193-197).  Quorum counting (QuorumWaiter, vote gathering)
+is built directly on these futures.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import logging
+from typing import Deque, Dict, List, Sequence, Tuple
+
+from .framing import MAX_FRAME, parse_address, read_frame, write_frame, sample_peers
+
+log = logging.getLogger(__name__)
+
+_BACKOFF_START = 0.2
+_BACKOFF_CAP = 60.0
+
+_Item = Tuple[bytes, asyncio.Future]
+
+
+class _Connection:
+    """Owns the channel to one peer: buffered retransmission until ACK.
+
+    Invariants that delivery semantics rest on:
+    - an item sits in exactly one of `buffer` (not yet written this
+      connection) or `pending` (written, awaiting ACK) until its future is
+      resolved or cancelled;
+    - the peer ACKs frames in order, so each ACK consumes exactly one
+      `pending` entry (cancelled entries included — their frame was written).
+    """
+
+    def __init__(self, address: str) -> None:
+        self.address = address
+        self.buffer: Deque[_Item] = collections.deque()
+        self.pending: Deque[_Item] = collections.deque()
+        self.wakeup = asyncio.Event()
+        self.task = asyncio.get_running_loop().create_task(self._keep_alive())
+
+    def push(self, data: bytes, fut: asyncio.Future) -> None:
+        self.buffer.append((data, fut))
+        self.wakeup.set()
+
+    def abort_all(self) -> None:
+        """Fail every outstanding delivery (sender shutdown)."""
+        for data, fut in list(self.pending) + list(self.buffer):
+            if not fut.done():
+                fut.cancel()
+        self.pending.clear()
+        self.buffer.clear()
+
+    def _requeue_pending(self) -> None:
+        """Move un-ACKed items back to the front of the buffer, oldest first,
+        dropping messages whose caller gave up (cancelled future)."""
+        while self.pending:
+            item = self.pending.pop()
+            if not item[1].cancelled():
+                self.buffer.appendleft(item)
+
+    async def _keep_alive(self) -> None:
+        host, port = parse_address(self.address)
+        delay = _BACKOFF_START
+        try:
+            while True:
+                try:
+                    reader, writer = await asyncio.open_connection(host, port)
+                except OSError as e:
+                    log.debug("ReliableSender: cannot reach %s: %s", self.address, e)
+                    await asyncio.sleep(delay)
+                    delay = min(delay * 2, _BACKOFF_CAP)
+                    continue
+                delay = _BACKOFF_START
+                try:
+                    await self._exchange(reader, writer)
+                except (ConnectionError, OSError, asyncio.IncompleteReadError) as e:
+                    log.debug("ReliableSender: lost %s: %s", self.address, e)
+                finally:
+                    writer.close()
+                    self._requeue_pending()
+        finally:
+            self._requeue_pending()
+
+    async def _exchange(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        """Pipeline writes from the buffer; match ACK frames FIFO."""
+
+        async def write_loop() -> None:
+            while True:
+                while self.buffer:
+                    data, fut = self.buffer.popleft()
+                    if fut.cancelled():
+                        continue
+                    # Into `pending` BEFORE the await: if the write (or this
+                    # task) dies mid-frame, reconnect retransmits it rather
+                    # than losing the message and wedging its future.
+                    self.pending.append((data, fut))
+                    await write_frame(writer, data)
+                self.wakeup.clear()
+                await self.wakeup.wait()
+
+        async def read_loop() -> None:
+            while True:
+                ack = await read_frame(reader)
+                # Exactly one pending entry per ACK frame — the peer ACKs
+                # everything we wrote, including since-cancelled messages.
+                if self.pending:
+                    _, fut = self.pending.popleft()
+                    if not fut.done():
+                        fut.set_result(ack)
+
+        w = asyncio.get_running_loop().create_task(write_loop())
+        r = asyncio.get_running_loop().create_task(read_loop())
+        try:
+            done, _ = await asyncio.wait({w, r}, return_when=asyncio.FIRST_COMPLETED)
+            for t in done:
+                exc = t.exception()
+                if exc is not None:
+                    raise exc
+        finally:
+            for t in (w, r):
+                t.cancel()
+            # Let cancellation unwind so neither loop touches the deques
+            # after we return.
+            await asyncio.gather(w, r, return_exceptions=True)
+
+
+class ReliableSender:
+    def __init__(self) -> None:
+        self._connections: Dict[str, _Connection] = {}
+
+    def _connection(self, address: str) -> _Connection:
+        conn = self._connections.get(address)
+        if conn is None or conn.task.done():
+            conn = _Connection(address)
+            self._connections[address] = conn
+        return conn
+
+    def send(self, address: str, data: bytes) -> asyncio.Future:
+        """Queue `data` for delivery; the returned future resolves with the
+        peer's ACK payload.  Cancel it to abandon delivery."""
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        if len(data) > MAX_FRAME:
+            fut.set_exception(
+                ValueError(f"message of {len(data)} bytes exceeds MAX_FRAME")
+            )
+            return fut
+        self._connection(address).push(data, fut)
+        return fut
+
+    def broadcast(
+        self, addresses: Sequence[str], data: bytes
+    ) -> List[asyncio.Future]:
+        return [self.send(addr, data) for addr in addresses]
+
+    def lucky_broadcast(
+        self, addresses: Sequence[str], data: bytes, nodes: int
+    ) -> List[asyncio.Future]:
+        """Send to `nodes` random peers (reference reliable_sender.rs:91-100)."""
+        return self.broadcast(sample_peers(addresses, nodes), data)
+
+    def close(self) -> None:
+        for conn in self._connections.values():
+            conn.task.cancel()
+            conn.abort_all()
+        self._connections.clear()

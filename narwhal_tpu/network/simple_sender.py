@@ -1,0 +1,85 @@
+"""Best-effort sender: one connection task per peer, drops on failure.
+
+Reference network/src/simple_sender.rs (143 LoC): used for sync replies,
+cleanup commands and helper responses — anything where the application-level
+retry logic (timers + lucky_broadcast) already provides liveness.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Dict, Sequence
+
+from .framing import parse_address, read_frame, sample_peers, write_frame
+
+log = logging.getLogger(__name__)
+
+_QUEUE_CAP = 1_000
+
+
+class _Peer:
+    def __init__(self, address: str) -> None:
+        self.address = address
+        self.queue: asyncio.Queue = asyncio.Queue(maxsize=_QUEUE_CAP)
+        self.task = asyncio.get_running_loop().create_task(self._run())
+
+    async def _run(self) -> None:
+        host, port = parse_address(self.address)
+        while True:
+            data = await self.queue.get()
+            try:
+                reader, writer = await asyncio.open_connection(host, port)
+            except OSError as e:
+                log.debug("SimpleSender: cannot reach %s: %s", self.address, e)
+                continue  # drop this message; try fresh on the next one
+            # Drain-and-discard replies (e.g. ACKs) so the peer's writes
+            # don't stall; best-effort senders ignore response content.
+            drain = asyncio.get_running_loop().create_task(self._drain(reader))
+            try:
+                while True:
+                    await write_frame(writer, data)
+                    data = await self.queue.get()
+            except (ConnectionError, OSError) as e:
+                log.debug("SimpleSender: lost %s: %s", self.address, e)
+            finally:
+                drain.cancel()
+                writer.close()
+
+    @staticmethod
+    async def _drain(reader: asyncio.StreamReader) -> None:
+        try:
+            while True:
+                await read_frame(reader)
+        except Exception:
+            pass
+
+
+class SimpleSender:
+    def __init__(self) -> None:
+        self._peers: Dict[str, _Peer] = {}
+
+    def send(self, address: str, data: bytes) -> None:
+        peer = self._peers.get(address)
+        if peer is None or peer.task.done():
+            peer = _Peer(address)
+            self._peers[address] = peer
+        try:
+            peer.queue.put_nowait(data)
+        except asyncio.QueueFull:
+            log.warning("SimpleSender: queue full for %s; dropping", address)
+
+    def broadcast(self, addresses: Sequence[str], data: bytes) -> None:
+        for addr in addresses:
+            self.send(addr, data)
+
+    def lucky_broadcast(
+        self, addresses: Sequence[str], data: bytes, nodes: int
+    ) -> None:
+        """Send to `nodes` random peers (reference simple_sender.rs:76-85)."""
+        self.broadcast(sample_peers(addresses, nodes), data)
+
+    def close(self) -> None:
+        for peer in self._peers.values():
+            peer.task.cancel()
+        self._peers.clear()

@@ -1,0 +1,87 @@
+"""Persistent KV store with `notify_read` — the dependency-resolution primitive.
+
+Reference store/src/lib.rs (94 LoC): a rocksdb behind an mpsc actor with three
+commands — Write, Read, and NotifyRead, a read that parks the caller until the
+key is written.  The obligations map is what the whole sync/recovery machinery
+is built on (SURVEY.md §2.1 row 4, §3.5).
+
+Here: an in-process map with an append-only log for crash recovery (replayed
+on open), and parked asyncio futures per missing key.  Since the protocol
+state machine runs on one event loop, plain-dict reads/writes are already
+serialized — the actor boundary of the reference collapses to method calls,
+which removes a channel hop from every hot-path store access.  A C++ backend
+(narwhal_tpu/native) can replace the log engine without changing this API.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import struct
+from typing import Dict, List, Optional
+
+_REC = struct.Struct("<II")  # key length, value length
+
+
+class Store:
+    def __init__(self, path: Optional[str] = None) -> None:
+        self._map: Dict[bytes, bytes] = {}
+        self._obligations: Dict[bytes, List[asyncio.Future]] = {}
+        self._log = None
+        if path is not None:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            if os.path.exists(path):
+                self._replay(path)
+            # buffering=0: each record reaches the OS page cache immediately,
+            # so a crashed process loses nothing (power-loss durability would
+            # need fsync, which the reference's rocksdb default skips too).
+            self._log = open(path, "ab", buffering=0)
+
+    def _replay(self, path: str) -> None:
+        with open(path, "rb") as f:
+            data = f.read()
+        pos, n = 0, len(data)
+        while pos + _REC.size <= n:
+            klen, vlen = _REC.unpack_from(data, pos)
+            end = pos + _REC.size + klen + vlen
+            if end > n:
+                break  # torn tail record from a crash; discard
+            k = data[pos + _REC.size : pos + _REC.size + klen]
+            self._map[k] = data[pos + _REC.size + klen : end]
+            pos = end
+
+    def write(self, key: bytes, value: bytes) -> None:
+        self._map[key] = value
+        if self._log is not None:
+            # One write() call per record: atomic w.r.t. our own replay logic
+            # and a single syscall on the unbuffered stream.
+            self._log.write(_REC.pack(len(key), len(value)) + key + value)
+        # Wake every parked notify_read on this key.
+        waiters = self._obligations.pop(key, None)
+        if waiters:
+            for fut in waiters:
+                if not fut.done():
+                    fut.set_result(value)
+
+    def read(self, key: bytes) -> Optional[bytes]:
+        return self._map.get(key)
+
+    async def notify_read(self, key: bytes) -> bytes:
+        """Return the value for `key`, parking until it is written if absent
+        (reference store/src/lib.rs:47-58)."""
+        val = self._map.get(key)
+        if val is not None:
+            return val
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._obligations.setdefault(key, []).append(fut)
+        return await fut
+
+    def flush(self) -> None:
+        if self._log is not None:
+            self._log.flush()
+
+    def close(self) -> None:
+        if self._log is not None:
+            self._log.flush()
+            self._log.close()
+            self._log = None

@@ -1,0 +1,187 @@
+"""Analog of reference network/src/tests: receiver dispatch, simple send,
+broadcast, reliable send with ACK futures and retry across a peer restart.
+Multi-node behavior is tested in one process over loopback TCP, as in the
+reference (SURVEY.md §4)."""
+
+import asyncio
+
+import pytest
+
+from narwhal_tpu.network import Receiver, ReliableSender, SimpleSender
+
+
+class EchoAckHandler:
+    """ACKs every frame with b"Ack" and records messages."""
+
+    def __init__(self):
+        self.received = []
+
+    async def dispatch(self, writer, message):
+        self.received.append(message)
+        await writer.send(b"Ack")
+
+
+class SilentHandler:
+    def __init__(self):
+        self.received = []
+
+    async def dispatch(self, writer, message):
+        self.received.append(message)
+
+
+@pytest.fixture
+def run():
+    def _run(coro):
+        return asyncio.run(asyncio.wait_for(coro, 15))
+
+    return _run
+
+
+def test_receive_and_reply(run):
+    async def go():
+        handler = EchoAckHandler()
+        recv = await Receiver.spawn("127.0.0.1:0", handler)
+        addr = f"127.0.0.1:{recv.port}"
+        reader, writer = await asyncio.open_connection("127.0.0.1", recv.port)
+        from narwhal_tpu.network.framing import write_frame, read_frame
+
+        await write_frame(writer, b"hello")
+        assert await read_frame(reader) == b"Ack"
+        assert handler.received == [b"hello"]
+        writer.close()
+        await recv.shutdown()
+        return addr
+
+    run(go())
+
+
+def test_simple_send(run):
+    async def go():
+        handler = EchoAckHandler()
+        recv = await Receiver.spawn("127.0.0.1:0", handler)
+        sender = SimpleSender()
+        sender.send(f"127.0.0.1:{recv.port}", b"msg")
+        for _ in range(100):
+            if handler.received:
+                break
+            await asyncio.sleep(0.01)
+        assert handler.received == [b"msg"]
+        sender.close()
+        await recv.shutdown()
+
+    run(go())
+
+
+def test_simple_broadcast(run):
+    async def go():
+        handlers = [SilentHandler() for _ in range(3)]
+        recvs = [await Receiver.spawn("127.0.0.1:0", h) for h in handlers]
+        sender = SimpleSender()
+        sender.broadcast([f"127.0.0.1:{r.port}" for r in recvs], b"all")
+        for _ in range(100):
+            if all(h.received for h in handlers):
+                break
+            await asyncio.sleep(0.01)
+        assert [h.received for h in handlers] == [[b"all"]] * 3
+        sender.close()
+        for r in recvs:
+            await r.shutdown()
+
+    run(go())
+
+
+def test_reliable_send_resolves_on_ack(run):
+    async def go():
+        handler = EchoAckHandler()
+        recv = await Receiver.spawn("127.0.0.1:0", handler)
+        sender = ReliableSender()
+        fut = sender.send(f"127.0.0.1:{recv.port}", b"important")
+        assert await fut == b"Ack"
+        assert handler.received == [b"important"]
+        sender.close()
+        await recv.shutdown()
+
+    run(go())
+
+
+def test_reliable_broadcast_quorum(run):
+    async def go():
+        handlers = [EchoAckHandler() for _ in range(4)]
+        recvs = [await Receiver.spawn("127.0.0.1:0", h) for h in handlers]
+        sender = ReliableSender()
+        futs = sender.broadcast([f"127.0.0.1:{r.port}" for r in recvs], b"b")
+        done, _ = await asyncio.wait(futs, return_when=asyncio.ALL_COMPLETED)
+        assert all(f.result() == b"Ack" for f in done)
+        sender.close()
+        for r in recvs:
+            await r.shutdown()
+
+    run(go())
+
+
+def test_reliable_send_retries_across_restart(run):
+    """Send to a dead peer; boot the peer afterwards; delivery happens."""
+
+    async def go():
+        # Reserve a port by binding then shutting down.
+        probe = await Receiver.spawn("127.0.0.1:0", SilentHandler())
+        port = probe.port
+        await probe.shutdown()
+
+        sender = ReliableSender()
+        fut = sender.send(f"127.0.0.1:{port}", b"late")
+        await asyncio.sleep(0.3)  # a few failed connect attempts
+        assert not fut.done()
+        handler = EchoAckHandler()
+        recv = await Receiver.spawn(f"127.0.0.1:{port}", handler)
+        assert await asyncio.wait_for(fut, 10) == b"Ack"
+        assert handler.received == [b"late"]
+        sender.close()
+        await recv.shutdown()
+
+    run(go())
+
+
+def test_reliable_cancel_abandons_delivery(run):
+    async def go():
+        probe = await Receiver.spawn("127.0.0.1:0", SilentHandler())
+        port = probe.port
+        await probe.shutdown()
+        sender = ReliableSender()
+        fut = sender.send(f"127.0.0.1:{port}", b"gone")
+        fut.cancel()
+        await asyncio.sleep(0.3)
+        handler = EchoAckHandler()
+        recv = await Receiver.spawn(f"127.0.0.1:{port}", handler)
+        await asyncio.sleep(0.5)
+        assert handler.received == []  # cancelled message never delivered
+        sender.close()
+        await recv.shutdown()
+
+    run(go())
+
+
+def test_oversized_message_fails_fast(run):
+    async def go():
+        sender = ReliableSender()
+        fut = sender.send("127.0.0.1:1", b"x" * (33 * 1024 * 1024))
+        try:
+            await fut
+            assert False
+        except ValueError:
+            pass
+        sender.close()
+
+    run(go())
+
+
+def test_close_cancels_outstanding(run):
+    async def go():
+        sender = ReliableSender()
+        fut = sender.send("127.0.0.1:1", b"never")  # unreachable peer
+        await asyncio.sleep(0.05)
+        sender.close()
+        await asyncio.sleep(0)
+        assert fut.cancelled()
+
+    run(go())
