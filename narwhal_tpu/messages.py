@@ -1,0 +1,140 @@
+"""Wire messages of the worker plane and the worker↔primary LAN plane.
+
+Reference enums: `WorkerMessage` (worker/src/worker.rs:36-40),
+`PrimaryWorkerMessage` (primary/src/primary.rs:41-47), `WorkerPrimaryMessage`
+(primary/src/primary.rs:50-56).  Each plane has its own socket, so tag spaces
+are independent.  Encoding: u8 tag + canonical serde body.
+
+The primary↔primary plane (Header/Vote/Certificate) lives in
+narwhal_tpu.primary.messages.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Tuple
+
+from .crypto import Digest, PublicKey
+from .utils.serde import Reader, Writer
+
+Transaction = bytes
+Batch = List[Transaction]
+Round = int
+WorkerId = int
+
+
+# --- worker ↔ worker ---------------------------------------------------------
+
+WORKER_BATCH = 0
+WORKER_BATCH_REQUEST = 1
+
+
+def encode_batch(batch: Batch) -> bytes:
+    """WorkerMessage::Batch — THE hot serialization path (≈500 kB frames)."""
+    w = Writer()
+    w.u8(WORKER_BATCH)
+    w.u32(len(batch))
+    for tx in batch:
+        w.bytes(tx)
+    return w.finish()
+
+
+def decode_batch_body(r: Reader) -> Batch:
+    n = r.u32()
+    return [r.bytes() for _ in range(n)]
+
+
+def encode_batch_request(digests: List[Digest], requestor: PublicKey) -> bytes:
+    w = Writer()
+    w.u8(WORKER_BATCH_REQUEST)
+    w.u32(len(digests))
+    for d in digests:
+        w.raw(d)
+    w.raw(requestor)
+    return w.finish()
+
+
+def decode_worker_message(data: bytes):
+    """Returns ("batch", Batch) | ("batch_request", digests, requestor)."""
+    r = Reader(data)
+    tag = r.u8()
+    if tag == WORKER_BATCH:
+        batch = decode_batch_body(r)
+        r.expect_done()
+        return ("batch", batch)
+    if tag == WORKER_BATCH_REQUEST:
+        n = r.u32()
+        digests = [Digest(r.raw(32)) for _ in range(n)]
+        requestor = PublicKey(r.raw(32))
+        r.expect_done()
+        return ("batch_request", digests, requestor)
+    raise ValueError(f"unknown WorkerMessage tag {tag}")
+
+
+# --- primary → worker (LAN) --------------------------------------------------
+
+PW_SYNCHRONIZE = 0
+PW_CLEANUP = 1
+
+
+def encode_synchronize(digests: List[Digest], target: PublicKey) -> bytes:
+    w = Writer()
+    w.u8(PW_SYNCHRONIZE)
+    w.u32(len(digests))
+    for d in digests:
+        w.raw(d)
+    w.raw(target)
+    return w.finish()
+
+
+def encode_cleanup(round: Round) -> bytes:
+    return Writer().u8(PW_CLEANUP).u64(round).finish()
+
+
+def decode_primary_worker_message(data: bytes):
+    """Returns ("synchronize", digests, target) | ("cleanup", round)."""
+    r = Reader(data)
+    tag = r.u8()
+    if tag == PW_SYNCHRONIZE:
+        n = r.u32()
+        digests = [Digest(r.raw(32)) for _ in range(n)]
+        target = PublicKey(r.raw(32))
+        r.expect_done()
+        return ("synchronize", digests, target)
+    if tag == PW_CLEANUP:
+        rnd = r.u64()
+        r.expect_done()
+        return ("cleanup", rnd)
+    raise ValueError(f"unknown PrimaryWorkerMessage tag {tag}")
+
+
+# --- worker → primary (LAN) --------------------------------------------------
+
+WP_OUR_BATCH = 0
+WP_OTHERS_BATCH = 1
+
+
+@dataclass(frozen=True)
+class BatchDigestMessage:
+    digest: Digest
+    worker_id: WorkerId
+    ours: bool
+
+
+def encode_batch_digest(digest: Digest, worker_id: WorkerId, ours: bool) -> bytes:
+    w = Writer()
+    w.u8(WP_OUR_BATCH if ours else WP_OTHERS_BATCH)
+    w.raw(digest)
+    w.u32(worker_id)
+    return w.finish()
+
+
+def decode_worker_primary_message(data: bytes) -> BatchDigestMessage:
+    r = Reader(data)
+    tag = r.u8()
+    if tag not in (WP_OUR_BATCH, WP_OTHERS_BATCH):
+        raise ValueError(f"unknown WorkerPrimaryMessage tag {tag}")
+    digest = Digest(r.raw(32))
+    worker_id = r.u32()
+    r.expect_done()
+    return BatchDigestMessage(digest, worker_id, tag == WP_OUR_BATCH)

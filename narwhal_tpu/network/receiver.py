@@ -37,6 +37,8 @@ class Receiver:
         self.address = address
         self.handler = handler
         self._server: asyncio.AbstractServer | None = None
+        self._connections: set = set()
+        self._closing = False
 
     @classmethod
     async def spawn(cls, address: str, handler: MessageHandler) -> "Receiver":
@@ -46,13 +48,28 @@ class Receiver:
         log.debug("Listening on %s", address)
         return self
 
+    def _on_connection(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        # Synchronous accept callback: register the handler task BEFORE any
+        # await, so shutdown() can never miss a just-accepted connection
+        # (Python ≥3.12 Server.wait_closed() blocks on every live handler).
+        if self._closing:
+            writer.close()
+            return
+        task = asyncio.get_running_loop().create_task(
+            self._handle(reader, writer)
+        )
+        self._connections.add(task)
+        task.add_done_callback(self._connections.discard)
+
     @property
     def port(self) -> int:
         """Actual bound port (useful when spawned with port 0)."""
         assert self._server is not None
         return self._server.sockets[0].getsockname()[1]
 
-    async def _on_connection(
+    async def _handle(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
         peer = writer.get_extra_info("peername")
@@ -76,6 +93,11 @@ class Receiver:
 
     async def shutdown(self) -> None:
         if self._server is not None:
+            self._closing = True
             self._server.close()
+            for task in list(self._connections):
+                task.cancel()
+            await asyncio.gather(*self._connections, return_exceptions=True)
+            self._connections.clear()
             await self._server.wait_closed()
             self._server = None

@@ -74,7 +74,20 @@ class Store:
             return val
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._obligations.setdefault(key, []).append(fut)
-        return await fut
+        try:
+            return await fut
+        finally:
+            # A cancelled waiter must not leak its obligation entry (keys that
+            # never arrive would otherwise accumulate futures forever).
+            if fut.cancelled():
+                waiters = self._obligations.get(key)
+                if waiters is not None:
+                    try:
+                        waiters.remove(fut)
+                    except ValueError:
+                        pass
+                    if not waiters:
+                        del self._obligations[key]
 
     def flush(self) -> None:
         if self._log is not None:

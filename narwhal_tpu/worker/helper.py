@@ -1,0 +1,48 @@
+"""Worker Helper: serve other workers' BatchRequests from our store.
+
+Reference worker/src/helper.rs (71 LoC): read each requested digest and send
+the raw serialized batch back to the requestor's same-id worker.  The reply
+is a regular WorkerMessage::Batch frame, so the requestor's normal batch path
+(Processor → store → OthersBatch digest) resolves the wait.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from ..config import Committee, WorkerId
+from ..crypto import PublicKey
+from ..network import SimpleSender
+
+log = logging.getLogger("narwhal.worker")
+
+
+class Helper:
+    def __init__(
+        self,
+        worker_id: WorkerId,
+        committee: Committee,
+        store,
+        in_queue: asyncio.Queue,  # (digests, requestor)
+    ) -> None:
+        self.worker_id = worker_id
+        self.committee = committee
+        self.store = store
+        self.in_queue = in_queue
+        self.sender = SimpleSender()
+
+    async def run(self) -> None:
+        while True:
+            digests, requestor = await self.in_queue.get()
+            try:
+                address = self.committee.worker(
+                    requestor, self.worker_id
+                ).worker_to_worker
+            except Exception:
+                log.warning("Received batch request from unknown authority")
+                continue
+            for digest in digests:
+                serialized = self.store.read(bytes(digest))
+                if serialized is not None:
+                    self.sender.send(address, serialized)

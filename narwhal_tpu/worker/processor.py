@@ -1,0 +1,41 @@
+"""Processor: hash, persist and report each batch.
+
+Reference worker/src/processor.rs (57 LoC): SHA-512/32B digest of the
+serialized batch (line 35 — the per-batch hot loop), write `digest → batch`
+to the store, emit OurBatch/OthersBatch(digest, worker_id) toward the
+primary.  Spawned twice: once for our sealed batches, once for batches
+received from other workers.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from ..config import WorkerId
+from ..crypto import sha512_digest
+from ..messages import encode_batch_digest
+
+
+class Processor:
+    def __init__(
+        self,
+        worker_id: WorkerId,
+        store,
+        in_queue: asyncio.Queue,  # serialized batches
+        out_queue: asyncio.Queue,  # → PrimaryConnector: encoded digest message
+        own_digests: bool,
+    ) -> None:
+        self.worker_id = worker_id
+        self.store = store
+        self.in_queue = in_queue
+        self.out_queue = out_queue
+        self.own_digests = own_digests
+
+    async def run(self) -> None:
+        while True:
+            serialized = await self.in_queue.get()
+            digest = sha512_digest(serialized)
+            self.store.write(bytes(digest), serialized)
+            await self.out_queue.put(
+                encode_batch_digest(digest, self.worker_id, self.own_digests)
+            )

@@ -1,0 +1,53 @@
+"""QuorumWaiter: hold each sealed batch until 2f+1 stake has ACKed it.
+
+Reference worker/src/quorum_waiter.rs (87 LoC): wait on the ACK futures until
+the acknowledging stake (including our own) reaches the quorum threshold,
+then release the batch downstream; remaining in-flight deliveries are
+abandoned (their retransmission pressure ends with the cancel).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from ..config import Committee
+from ..crypto import PublicKey
+
+log = logging.getLogger("narwhal.worker")
+
+
+class QuorumWaiter:
+    def __init__(
+        self,
+        name: PublicKey,
+        committee: Committee,
+        in_queue: asyncio.Queue,  # ← BatchMaker: (serialized, [(stake, fut)])
+        out_queue: asyncio.Queue,  # → Processor: serialized batch
+    ) -> None:
+        self.name = name
+        self.committee = committee
+        self.in_queue = in_queue
+        self.out_queue = out_queue
+
+    async def run(self) -> None:
+        threshold = self.committee.quorum_threshold()
+        while True:
+            serialized, handlers = await self.in_queue.get()
+            total = self.committee.stake(self.name)  # our own stake counts
+            pending = {fut: stake for stake, fut in handlers}
+            while total < threshold and pending:
+                done, _ = await asyncio.wait(
+                    set(pending), return_when=asyncio.FIRST_COMPLETED
+                )
+                for fut in done:
+                    stake = pending.pop(fut)
+                    if not fut.cancelled() and fut.exception() is None:
+                        total += stake
+            # Quorum reached (or unreachable): abandon in-flight deliveries.
+            for fut in pending:
+                fut.cancel()
+            if total >= threshold:
+                await self.out_queue.put(serialized)
+            else:
+                log.warning("Batch dropped: quorum unreachable (got %d)", total)

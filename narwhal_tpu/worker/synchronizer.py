@@ -1,0 +1,129 @@
+"""Worker Synchronizer: fetch batches our primary is waiting for.
+
+Reference worker/src/synchronizer.rs (226 LoC): execute the primary's
+`Synchronize` commands — check the store, record pending requests, send a
+`BatchRequest` to the target author's same-id worker; a 1 s resolution timer
+re-broadcasts to `sync_retry_nodes` random peers once `sync_retry_delay`
+elapses (191-222); `Cleanup(round)` garbage-collects pending state (160-176).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Dict, Tuple
+
+from ..config import Committee, WorkerId
+from ..crypto import Digest, PublicKey
+from ..messages import Round, encode_batch_request
+from ..network import SimpleSender
+
+log = logging.getLogger("narwhal.worker")
+
+TIMER_RESOLUTION = 1.0  # seconds (reference synchronizer.rs:22)
+
+
+class Synchronizer:
+    def __init__(
+        self,
+        name: PublicKey,
+        worker_id: WorkerId,
+        committee: Committee,
+        store,
+        sync_retry_delay_ms: int,
+        sync_retry_nodes: int,
+        in_queue: asyncio.Queue,  # decoded PrimaryWorkerMessage tuples
+        gc_depth: Round = 50,
+    ) -> None:
+        self.name = name
+        self.worker_id = worker_id
+        self.committee = committee
+        self.store = store
+        self.sync_retry_delay = sync_retry_delay_ms / 1000.0
+        self.sync_retry_nodes = sync_retry_nodes
+        self.in_queue = in_queue
+        self.gc_depth = gc_depth
+        self.sender = SimpleSender()
+        self.round: Round = 0
+        # digest → (round at request time, request timestamp)
+        self.pending: Dict[Digest, Tuple[Round, float]] = {}
+        self._waiters: Dict[Digest, asyncio.Task] = {}
+
+    async def run(self) -> None:
+        timer = asyncio.get_running_loop().create_task(self._timer())
+        try:
+            while True:
+                cmd = await self.in_queue.get()
+                if cmd[0] == "synchronize":
+                    _, digests, target = cmd
+                    await self._synchronize(digests, target)
+                elif cmd[0] == "cleanup":
+                    self._cleanup(cmd[1])
+        finally:
+            timer.cancel()
+
+    async def _synchronize(self, digests, target: PublicKey) -> None:
+        missing = []
+        now = time.monotonic()
+        for digest in digests:
+            if digest in self.pending:
+                continue
+            if self.store.read(bytes(digest)) is not None:
+                continue
+            missing.append(digest)
+            self.pending[digest] = (self.round, now)
+            # Clear pending as soon as the batch lands in the store
+            # (the Processor writes it when the Helper's reply arrives).
+            self._waiters[digest] = asyncio.get_running_loop().create_task(
+                self._await_arrival(digest)
+            )
+        if not missing:
+            return
+        message = encode_batch_request(missing, self.name)
+        try:
+            address = self.committee.worker(target, self.worker_id).worker_to_worker
+        except Exception:
+            log.warning("Sync request for unknown target authority")
+            return
+        self.sender.send(address, message)
+
+    async def _await_arrival(self, digest: Digest) -> None:
+        await self.store.notify_read(bytes(digest))
+        self.pending.pop(digest, None)
+        self._waiters.pop(digest, None)
+
+    def _cleanup(self, round: Round) -> None:
+        """Drop requests older than the GC window — they can no longer matter
+        to header validation (reference synchronizer.rs:160-176 retains
+        entries for gc_depth rounds, not merely the current round)."""
+        self.round = round
+        horizon = round - self.gc_depth
+        for digest in [d for d, (r, _) in self.pending.items() if r < horizon]:
+            del self.pending[digest]
+            waiter = self._waiters.pop(digest, None)
+            if waiter is not None:
+                waiter.cancel()
+
+    async def _timer(self) -> None:
+        """Escalate overdue requests to `sync_retry_nodes` random peers
+        (reference synchronizer.rs:191-222)."""
+        while True:
+            await asyncio.sleep(TIMER_RESOLUTION)
+            now = time.monotonic()
+            overdue = [
+                d
+                for d, (_, t) in self.pending.items()
+                if now - t >= self.sync_retry_delay
+            ]
+            if not overdue:
+                continue
+            addresses = [
+                addrs.worker_to_worker
+                for _, addrs in self.committee.others_workers(self.name, self.worker_id)
+            ]
+            message = encode_batch_request(overdue, self.name)
+            self.sender.lucky_broadcast(addresses, message, self.sync_retry_nodes)
+            for d in overdue:
+                r, _ = self.pending[d]
+                self.pending[d] = (r, now)

@@ -1,0 +1,207 @@
+"""Worker wiring: three inbound planes, three pipelines.
+
+Reference worker/src/worker.rs (318 LoC): `Worker::spawn` wires
+- client transactions → BatchMaker → QuorumWaiter → Processor(own) →
+  PrimaryConnector (the throughput hot path, SURVEY.md §3.2),
+- other workers' frames → ACK → Processor(others) / Helper,
+- primary commands → Synchronizer.
+Channel capacity 1000 throughout (worker.rs:26) for backpressure.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import List
+
+from ..config import Committee, Parameters, WorkerId
+from ..crypto import PublicKey
+from ..messages import decode_primary_worker_message, decode_worker_message
+from ..network import Receiver, Writer
+from ..store import Store
+from .batch_maker import BatchMaker
+from .helper import Helper
+from .primary_connector import PrimaryConnector
+from .processor import Processor
+from .quorum_waiter import QuorumWaiter
+from .synchronizer import Synchronizer
+
+log = logging.getLogger("narwhal.worker")
+
+CHANNEL_CAPACITY = 1_000
+
+
+class TxReceiverHandler:
+    """Client transactions: no ACK, straight into the BatchMaker
+    (reference worker.rs:243-261)."""
+
+    def __init__(self, tx_queue: asyncio.Queue) -> None:
+        self.tx_queue = tx_queue
+
+    async def dispatch(self, writer: Writer, message: bytes) -> None:
+        await self.tx_queue.put(message)
+
+
+class WorkerReceiverHandler:
+    """Other workers' traffic: ACK everything, route batches to the
+    others-Processor and batch requests to the Helper
+    (reference worker.rs:264-292)."""
+
+    def __init__(
+        self, others_queue: asyncio.Queue, helper_queue: asyncio.Queue
+    ) -> None:
+        self.others_queue = others_queue
+        self.helper_queue = helper_queue
+
+    async def dispatch(self, writer: Writer, message: bytes) -> None:
+        try:
+            decoded = decode_worker_message(message)
+        except ValueError as e:
+            log.warning("Dropping malformed worker message: %s", e)
+            return
+        await writer.send(b"Ack")
+        if decoded[0] == "batch":
+            # Keep the raw frame: its bytes are the hashing/storage unit.
+            await self.others_queue.put(message)
+        else:
+            _, digests, requestor = decoded
+            await self.helper_queue.put((digests, requestor))
+
+
+class PrimaryReceiverHandler:
+    """Commands from our primary (reference worker.rs:295-318)."""
+
+    def __init__(self, sync_queue: asyncio.Queue) -> None:
+        self.sync_queue = sync_queue
+
+    async def dispatch(self, writer: Writer, message: bytes) -> None:
+        try:
+            cmd = decode_primary_worker_message(message)
+        except ValueError as e:
+            log.warning("Dropping malformed primary message: %s", e)
+            return
+        await self.sync_queue.put(cmd)
+
+
+class Worker:
+    def __init__(
+        self,
+        name: PublicKey,
+        worker_id: WorkerId,
+        committee: Committee,
+        parameters: Parameters,
+        store: Store,
+        benchmark: bool = False,
+    ) -> None:
+        self.name = name
+        self.worker_id = worker_id
+        self.committee = committee
+        self.parameters = parameters
+        self.store = store
+        self.benchmark = benchmark
+        self.tasks: List[asyncio.Task] = []
+        self.receivers: List[Receiver] = []
+        self.senders: List = []  # network senders owned by our components
+
+    @classmethod
+    async def spawn(
+        cls,
+        name: PublicKey,
+        worker_id: WorkerId,
+        committee: Committee,
+        parameters: Parameters,
+        store: Store,
+        benchmark: bool = False,
+    ) -> "Worker":
+        self = cls(name, worker_id, committee, parameters, store, benchmark)
+        loop = asyncio.get_running_loop()
+        q = lambda: asyncio.Queue(maxsize=CHANNEL_CAPACITY)  # noqa: E731
+
+        tx_queue = q()
+        to_quorum = q()
+        own_batches = q()
+        others_batches = q()
+        to_primary = q()
+        helper_queue = q()
+        sync_queue = q()
+
+        addrs = committee.worker(name, worker_id)
+        primary_addr = committee.primary(name).worker_to_primary
+
+        # Inbound planes.
+        self.receivers.append(
+            await Receiver.spawn(addrs.transactions, TxReceiverHandler(tx_queue))
+        )
+        self.receivers.append(
+            await Receiver.spawn(
+                addrs.worker_to_worker,
+                WorkerReceiverHandler(others_batches, helper_queue),
+            )
+        )
+        self.receivers.append(
+            await Receiver.spawn(
+                addrs.primary_to_worker, PrimaryReceiverHandler(sync_queue)
+            )
+        )
+
+        # Pipelines.
+        batch_maker = BatchMaker(
+            name,
+            worker_id,
+            committee,
+            parameters.batch_size,
+            parameters.max_batch_delay,
+            tx_queue,
+            to_quorum,
+            benchmark=benchmark,
+        )
+        quorum_waiter = QuorumWaiter(name, committee, to_quorum, own_batches)
+        processor_own = Processor(worker_id, store, own_batches, to_primary, True)
+        processor_others = Processor(
+            worker_id, store, others_batches, to_primary, False
+        )
+        connector = PrimaryConnector(primary_addr, to_primary)
+        synchronizer = Synchronizer(
+            name,
+            worker_id,
+            committee,
+            store,
+            parameters.sync_retry_delay,
+            parameters.sync_retry_nodes,
+            sync_queue,
+            gc_depth=parameters.gc_depth,
+        )
+        helper = Helper(worker_id, committee, store, helper_queue)
+        self.senders = [
+            batch_maker.sender,
+            connector.sender,
+            synchronizer.sender,
+            helper.sender,
+        ]
+
+        for runner in (
+            batch_maker,
+            quorum_waiter,
+            processor_own,
+            processor_others,
+            connector,
+            synchronizer,
+            helper,
+        ):
+            self.tasks.append(loop.create_task(runner.run()))
+
+        log.info(
+            "Worker %d successfully booted on %s",
+            worker_id,
+            addrs.transactions.rsplit(":", 1)[0],
+        )
+        return self
+
+    async def shutdown(self) -> None:
+        for task in self.tasks:
+            task.cancel()
+        for sender in self.senders:
+            sender.close()
+        for receiver in self.receivers:
+            await receiver.shutdown()
+        await asyncio.gather(*self.tasks, return_exceptions=True)

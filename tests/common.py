@@ -48,3 +48,48 @@ def committee(base_port: int = 0, n: int = 4, workers: int = 1) -> Committee:
             )
         authorities[kp.name] = Authority(stake=1, primary=primary, workers=ws)
     return Committee(authorities)
+
+
+# --- worker-plane fixtures (analog of reference worker/src/tests/common.rs) ---
+
+from narwhal_tpu.crypto import sha512_digest  # noqa: E402
+from narwhal_tpu.messages import encode_batch  # noqa: E402
+
+
+def transaction(sample_id: int = 5) -> bytes:
+    """A 'sample' transaction: byte0=0 + u64 id + padding."""
+    return bytes([0]) + sample_id.to_bytes(8, "little") + bytes(91)
+
+
+def filler_transaction() -> bytes:
+    return bytes([1]) + (7).to_bytes(8, "little") + bytes(91)
+
+
+def batch():
+    return [transaction(), filler_transaction()]
+
+
+def serialized_batch() -> bytes:
+    return encode_batch(batch())
+
+
+def batch_digest():
+    return sha512_digest(serialized_batch())
+
+
+class RecordingAckHandler:
+    """Fake peer: ACKs every frame and records it (analog of the reference's
+    `listener(address)` fixture, primary/src/tests/common.rs:169-183)."""
+
+    def __init__(self, ack: bool = True):
+        self.ack = ack
+        self.received = []
+        import asyncio
+
+        self.arrived = asyncio.Event()
+
+    async def dispatch(self, writer, message: bytes) -> None:
+        self.received.append(message)
+        self.arrived.set()
+        if self.ack:
+            await writer.send(b"Ack")

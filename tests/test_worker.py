@@ -1,0 +1,244 @@
+"""Worker pipeline tests, mirroring the reference worker crate's coverage:
+seal by size and by timeout, quorum over real ACKs, processor hash+store+
+forward, sync request emission, helper replies, and the full worker e2e
+(txs in → digest at fake primary).  Fake peers are real TCP listeners in the
+same process (SURVEY.md §4)."""
+
+import asyncio
+
+import pytest
+
+from narwhal_tpu.config import Parameters
+from narwhal_tpu.crypto import sha512_digest
+from narwhal_tpu.messages import (
+    decode_worker_message,
+    decode_worker_primary_message,
+    encode_batch,
+)
+from narwhal_tpu.network import Receiver
+from narwhal_tpu.store import Store
+from narwhal_tpu.worker import Worker
+from narwhal_tpu.worker.batch_maker import BatchMaker
+from narwhal_tpu.worker.helper import Helper
+from narwhal_tpu.worker.processor import Processor
+from narwhal_tpu.worker.quorum_waiter import QuorumWaiter
+from narwhal_tpu.worker.synchronizer import Synchronizer
+
+from tests.common import (
+    RecordingAckHandler,
+    batch,
+    batch_digest,
+    committee,
+    keys,
+    serialized_batch,
+    transaction,
+)
+
+
+@pytest.fixture
+def run():
+    def _run(coro):
+        return asyncio.run(asyncio.wait_for(coro, 20))
+
+    return _run
+
+
+async def spawn_peer_listeners(c, myself, worker_id=0, ack=True):
+    """Bind RecordingAckHandlers on every other authority's same-id
+    worker_to_worker address."""
+    handlers = []
+    receivers = []
+    for _, addrs in c.others_workers(myself, worker_id):
+        h = RecordingAckHandler(ack=ack)
+        receivers.append(await Receiver.spawn(addrs.worker_to_worker, h))
+        handlers.append(h)
+    return handlers, receivers
+
+
+def test_batch_maker_seals_by_size(run):
+    async def go():
+        c = committee(base_port=11000)
+        me = keys()[0].name
+        handlers, receivers = await spawn_peer_listeners(c, me)
+        tx_q, out_q = asyncio.Queue(), asyncio.Queue()
+        maker = BatchMaker(me, 0, c, batch_size=200, max_batch_delay_ms=10_000,
+                           tx_queue=tx_q, out_queue=out_q)
+        task = asyncio.ensure_future(maker.run())
+        for tx in (transaction(), transaction()):
+            await tx_q.put(tx)
+        serialized, quorum_handlers = await asyncio.wait_for(out_q.get(), 5)
+        kind, decoded = decode_worker_message(serialized)
+        assert kind == "batch" and decoded == [transaction(), transaction()]
+        assert len(quorum_handlers) == 3  # one ACK future per other authority
+        task.cancel()
+        maker.sender.close()
+        for r in receivers:
+            await r.shutdown()
+
+    run(go())
+
+
+def test_batch_maker_seals_by_timeout(run):
+    async def go():
+        c = committee(base_port=11020)
+        me = keys()[0].name
+        handlers, receivers = await spawn_peer_listeners(c, me)
+        tx_q, out_q = asyncio.Queue(), asyncio.Queue()
+        maker = BatchMaker(me, 0, c, batch_size=1_000_000, max_batch_delay_ms=50,
+                           tx_queue=tx_q, out_queue=out_q)
+        task = asyncio.ensure_future(maker.run())
+        await tx_q.put(transaction())
+        serialized, _ = await asyncio.wait_for(out_q.get(), 5)
+        kind, decoded = decode_worker_message(serialized)
+        assert kind == "batch" and decoded == [transaction()]
+        task.cancel()
+        maker.sender.close()
+        for r in receivers:
+            await r.shutdown()
+
+    run(go())
+
+
+def test_quorum_waiter_releases_at_2f1(run):
+    async def go():
+        c = committee(base_port=11040)
+        me = keys()[0].name
+        handlers, receivers = await spawn_peer_listeners(c, me)
+        tx_q, to_quorum, released = asyncio.Queue(), asyncio.Queue(), asyncio.Queue()
+        maker = BatchMaker(me, 0, c, batch_size=200, max_batch_delay_ms=10_000,
+                           tx_queue=tx_q, out_queue=to_quorum)
+        waiter = QuorumWaiter(me, c, to_quorum, released)
+        t1 = asyncio.ensure_future(maker.run())
+        t2 = asyncio.ensure_future(waiter.run())
+        for tx in (transaction(), transaction()):
+            await tx_q.put(tx)
+        serialized = await asyncio.wait_for(released.get(), 10)
+        assert decode_worker_message(serialized)[0] == "batch"
+        # All three peers eventually saw the broadcast.
+        for h in handlers:
+            await asyncio.wait_for(h.arrived.wait(), 5)
+        for t in (t1, t2):
+            t.cancel()
+        maker.sender.close()
+        for r in receivers:
+            await r.shutdown()
+
+    run(go())
+
+
+def test_processor_hashes_stores_forwards(run):
+    async def go():
+        store = Store()
+        in_q, out_q = asyncio.Queue(), asyncio.Queue()
+        proc = Processor(3, store, in_q, out_q, own_digests=True)
+        task = asyncio.ensure_future(proc.run())
+        await in_q.put(serialized_batch())
+        msg = await asyncio.wait_for(out_q.get(), 5)
+        decoded = decode_worker_primary_message(msg)
+        assert decoded.digest == batch_digest()
+        assert decoded.worker_id == 3 and decoded.ours
+        assert store.read(bytes(batch_digest())) == serialized_batch()
+        task.cancel()
+
+    run(go())
+
+
+def test_synchronizer_sends_batch_request(run):
+    async def go():
+        c = committee(base_port=11060)
+        me, target = keys()[0].name, keys()[1].name
+        h = RecordingAckHandler()
+        recv = await Receiver.spawn(c.worker(target, 0).worker_to_worker, h)
+        in_q = asyncio.Queue()
+        sync = Synchronizer(me, 0, c, Store(), 5_000, 3, in_q)
+        task = asyncio.ensure_future(sync.run())
+        missing = batch_digest()
+        await in_q.put(("synchronize", [missing], target))
+        await asyncio.wait_for(h.arrived.wait(), 5)
+        kind, digests, requestor = decode_worker_message(h.received[0])
+        assert kind == "batch_request" and digests == [missing] and requestor == me
+        task.cancel()
+        sync.sender.close()
+        await recv.shutdown()
+
+    run(go())
+
+
+def test_synchronizer_skips_stored_batches(run):
+    async def go():
+        c = committee(base_port=11080)
+        me, target = keys()[0].name, keys()[1].name
+        store = Store()
+        store.write(bytes(batch_digest()), serialized_batch())
+        h = RecordingAckHandler()
+        recv = await Receiver.spawn(c.worker(target, 0).worker_to_worker, h)
+        in_q = asyncio.Queue()
+        sync = Synchronizer(me, 0, c, store, 5_000, 3, in_q)
+        task = asyncio.ensure_future(sync.run())
+        await in_q.put(("synchronize", [batch_digest()], target))
+        await asyncio.sleep(0.3)
+        assert h.received == []  # already stored: no request goes out
+        task.cancel()
+        sync.sender.close()
+        await recv.shutdown()
+
+    run(go())
+
+
+def test_helper_replies_with_batches(run):
+    async def go():
+        c = committee(base_port=11100)
+        me, requestor = keys()[0].name, keys()[1].name
+        store = Store()
+        store.write(bytes(batch_digest()), serialized_batch())
+        h = RecordingAckHandler()
+        recv = await Receiver.spawn(c.worker(requestor, 0).worker_to_worker, h)
+        in_q = asyncio.Queue()
+        helper = Helper(0, c, store, in_q)
+        task = asyncio.ensure_future(helper.run())
+        await in_q.put(([batch_digest()], requestor))
+        await asyncio.wait_for(h.arrived.wait(), 5)
+        assert h.received == [serialized_batch()]
+        task.cancel()
+        helper.sender.close()
+        await recv.shutdown()
+
+    run(go())
+
+
+def test_worker_end_to_end(run):
+    """Client txs in → sealed batch broadcast + quorum → digest at our fake
+    primary (reference worker_tests.rs:94-130)."""
+
+    async def go():
+        c = committee(base_port=11120)
+        me = keys()[0].name
+        handlers, receivers = await spawn_peer_listeners(c, me)
+        primary_handler = RecordingAckHandler(ack=False)
+        primary_recv = await Receiver.spawn(
+            c.primary(me).worker_to_primary, primary_handler
+        )
+        params = Parameters(batch_size=200, max_batch_delay=10_000)
+        worker = await Worker.spawn(me, 0, c, params, Store())
+
+        # Drive transactions into the worker's client socket.
+        from narwhal_tpu.network.framing import write_frame
+
+        host, port = c.worker(me, 0).transactions.rsplit(":", 1)
+        _, w = await asyncio.open_connection(host, int(port))
+        txs = [transaction(), transaction()]
+        for tx in txs:
+            await write_frame(w, tx)
+
+        await asyncio.wait_for(primary_handler.arrived.wait(), 10)
+        decoded = decode_worker_primary_message(primary_handler.received[0])
+        assert decoded.ours and decoded.worker_id == 0
+        expected = sha512_digest(encode_batch(txs))
+        assert decoded.digest == expected
+        w.close()
+        await worker.shutdown()
+        await primary_recv.shutdown()
+        for r in receivers:
+            await r.shutdown()
+
+    run(go())
