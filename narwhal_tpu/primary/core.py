@@ -1,0 +1,284 @@
+"""Core: the DAG state machine.
+
+Reference primary/src/core.rs (412 LoC): one select loop over peer messages,
+waiter loopbacks and own proposals.  process_header (dedupe → parents present
++ quorum of round-1 → payload present → persist → vote once per (round,
+author)); process_vote (aggregate → broadcast certificate at quorum);
+process_certificate (ensure header processed, ancestors delivered, persist,
+feed CertificatesAggregator → advance round, forward to consensus).
+Sanitizers verify signatures and round bounds; per-round maps are GC'd from
+the shared consensus round.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Dict, List, Set
+
+from ..config import Committee
+from ..crypto import Digest, PublicKey, SignatureService
+from ..messages import Round
+from ..network import ReliableSender
+from ..store import Store
+from ..utils.serde import Writer
+from .aggregators import CertificatesAggregator, VotesAggregator
+from .errors import DagError, HeaderRequiresQuorum, MalformedHeader, TooOld, UnexpectedVote
+from .messages import (
+    Certificate,
+    Header,
+    Vote,
+    encode_primary_message,
+)
+from .synchronizer import Synchronizer
+
+log = logging.getLogger("narwhal.primary")
+
+
+class AtomicRound:
+    """Shared consensus-round cell (the reference's AtomicU64 with Relaxed
+    ordering, primary.rs:89 — plain attribute suffices on one event loop)."""
+
+    __slots__ = ("value",)
+
+    def __init__(self) -> None:
+        self.value: Round = 0
+
+
+class Core:
+    def __init__(
+        self,
+        name: PublicKey,
+        committee: Committee,
+        store: Store,
+        synchronizer: Synchronizer,
+        signature_service: SignatureService,
+        consensus_round: AtomicRound,
+        gc_depth: Round,
+        rx_primaries: asyncio.Queue,
+        rx_header_waiter: asyncio.Queue,
+        rx_certificate_waiter: asyncio.Queue,
+        rx_proposer: asyncio.Queue,
+        tx_consensus: asyncio.Queue,
+        tx_proposer: asyncio.Queue,
+    ) -> None:
+        self.name = name
+        self.committee = committee
+        self.store = store
+        self.synchronizer = synchronizer
+        self.signature_service = signature_service
+        self.consensus_round = consensus_round
+        self.gc_depth = gc_depth
+        self.rx_primaries = rx_primaries
+        self.rx_header_waiter = rx_header_waiter
+        self.rx_certificate_waiter = rx_certificate_waiter
+        self.rx_proposer = rx_proposer
+        self.tx_consensus = tx_consensus
+        self.tx_proposer = tx_proposer
+
+        self.gc_round: Round = 0
+        self.last_voted: Dict[Round, Set[PublicKey]] = {}
+        self.processing: Dict[Round, Set[Digest]] = {}
+        self.current_header: Header = Header(
+            author=name, round=0, payload={}, parents=set()
+        )
+        self.votes_aggregator = VotesAggregator()
+        self.certificates_aggregators: Dict[Round, CertificatesAggregator] = {}
+        self.network = ReliableSender()
+        self.cancel_handlers: Dict[Round, List[asyncio.Future]] = {}
+
+    # --- processing ---------------------------------------------------------
+
+    async def process_own_header(self, header: Header) -> None:
+        self.current_header = header
+        self.votes_aggregator = VotesAggregator()
+        addresses = [
+            a.primary_to_primary for _, a in self.committee.others_primaries(self.name)
+        ]
+        handlers = self.network.broadcast(addresses, encode_primary_message(header))
+        self.cancel_handlers.setdefault(header.round, []).extend(handlers)
+        await self.process_header(header)
+
+    async def process_header(self, header: Header) -> None:
+        log.debug("Processing %r", header)
+        self.processing.setdefault(header.round, set()).add(header.id)
+
+        # Ensure we have all parents; otherwise the HeaderWaiter will gather
+        # them and loop the header back to us.
+        parents = await self.synchronizer.get_parents(header)
+        if not parents:
+            log.debug("Processing of %r suspended: missing parent(s)", header.id)
+            return
+
+        # Parents must form a quorum, all from the previous round.
+        stake = 0
+        for parent in parents:
+            if parent.round + 1 != header.round:
+                raise MalformedHeader(repr(header.id))
+            stake += self.committee.stake(parent.origin)
+        if stake < self.committee.quorum_threshold():
+            raise HeaderRequiresQuorum(repr(header.id))
+
+        # Ensure we have the payload; otherwise our workers fetch it and the
+        # header comes back through the waiter.
+        if await self.synchronizer.missing_payload(header):
+            log.debug("Processing of %r suspended: missing payload", header.id)
+            return
+
+        # Store the header.
+        w = Writer()
+        header.encode(w)
+        self.store.write(bytes(header.id), w.finish())
+
+        # Vote at most once per (round, author).
+        voted = self.last_voted.setdefault(header.round, set())
+        if header.author not in voted:
+            voted.add(header.author)
+            vote = await Vote.new(header, self.name, self.signature_service)
+            log.debug("Created %r", vote)
+            if vote.origin == self.name:
+                await self.process_vote(vote)
+            else:
+                address = self.committee.primary(header.author).primary_to_primary
+                handler = self.network.send(address, encode_primary_message(vote))
+                self.cancel_handlers.setdefault(header.round, []).append(handler)
+
+    async def process_vote(self, vote: Vote) -> None:
+        log.debug("Processing %r", vote)
+        certificate = self.votes_aggregator.append(
+            vote, self.committee, self.current_header
+        )
+        if certificate is not None:
+            log.debug("Assembled %r", certificate)
+            addresses = [
+                a.primary_to_primary
+                for _, a in self.committee.others_primaries(self.name)
+            ]
+            handlers = self.network.broadcast(
+                addresses, encode_primary_message(certificate)
+            )
+            self.cancel_handlers.setdefault(certificate.round, []).extend(handlers)
+            await self.process_certificate(certificate)
+
+    async def process_certificate(self, certificate: Certificate) -> None:
+        log.debug("Processing %r", certificate)
+
+        # Process the embedded header if we haven't (certified ⇒ its data is
+        # retrievable, so processing may proceed regardless).
+        if certificate.header.id not in self.processing.get(
+            certificate.header.round, ()
+        ):
+            await self.process_header(certificate.header)
+
+        # All ancestors must be delivered before consensus sees this.
+        if not await self.synchronizer.deliver_certificate(certificate):
+            log.debug("Processing of %r suspended: missing ancestors", certificate)
+            return
+
+        # Store the certificate.
+        self.store.write(bytes(certificate.digest()), certificate.serialize())
+
+        # Enough certificates to advance the DAG round?
+        parents = self.certificates_aggregators.setdefault(
+            certificate.round, CertificatesAggregator()
+        ).append(certificate, self.committee)
+        if parents is not None:
+            await self.tx_proposer.put((parents, certificate.round))
+
+        await self.tx_consensus.put(certificate)
+
+    # --- sanitization -------------------------------------------------------
+
+    def sanitize_header(self, header: Header) -> None:
+        if header.round < self.gc_round:
+            raise TooOld(f"header {header.id!r} round {header.round}")
+        header.verify(self.committee)
+
+    def sanitize_vote(self, vote: Vote) -> None:
+        if vote.round < self.current_header.round:
+            raise TooOld(f"vote {vote.digest()!r} round {vote.round}")
+        if not (
+            vote.id == self.current_header.id
+            and vote.origin == self.current_header.author
+            and vote.round == self.current_header.round
+        ):
+            raise UnexpectedVote(repr(vote.id))
+        vote.verify(self.committee)
+
+    def sanitize_certificate(self, certificate: Certificate) -> None:
+        if certificate.round < self.gc_round:
+            raise TooOld(f"certificate {certificate.digest()!r}")
+        certificate.verify(self.committee)
+
+    # --- main loop ----------------------------------------------------------
+
+    async def _handle(self, source: str, item) -> None:
+        try:
+            if source == "primaries":
+                kind = item[0]
+                if kind == "header":
+                    self.sanitize_header(item[1])
+                    await self.process_header(item[1])
+                elif kind == "vote":
+                    self.sanitize_vote(item[1])
+                    await self.process_vote(item[1])
+                elif kind == "certificate":
+                    self.sanitize_certificate(item[1])
+                    await self.process_certificate(item[1])
+                else:
+                    log.warning("Unexpected core message %r", kind)
+            elif source == "header_waiter":
+                await self.process_header(item)
+            elif source == "certificate_waiter":
+                await self.process_certificate(item)
+            elif source == "proposer":
+                await self.process_own_header(item)
+        except TooOld as e:
+            log.debug("%s", e)
+        except DagError as e:
+            log.warning("%s", e)
+
+        # GC internal per-round state from the shared consensus round.
+        round = self.consensus_round.value
+        if round > self.gc_depth:
+            gc_round = round - self.gc_depth
+            for m in (
+                self.last_voted,
+                self.processing,
+                self.certificates_aggregators,
+            ):
+                for k in [k for k in m if k < gc_round]:
+                    del m[k]
+            for k in [k for k in self.cancel_handlers if k < gc_round]:
+                for fut in self.cancel_handlers[k]:
+                    fut.cancel()
+                del self.cancel_handlers[k]
+            self.gc_round = gc_round
+
+    async def run(self) -> None:
+        sources = {
+            "primaries": self.rx_primaries,
+            "header_waiter": self.rx_header_waiter,
+            "certificate_waiter": self.rx_certificate_waiter,
+            "proposer": self.rx_proposer,
+        }
+        loop = asyncio.get_running_loop()
+        gets = {
+            name: loop.create_task(q.get(), name=f"core-{name}")
+            for name, q in sources.items()
+        }
+        try:
+            while True:
+                done, _ = await asyncio.wait(
+                    set(gets.values()), return_when=asyncio.FIRST_COMPLETED
+                )
+                for name, task in list(gets.items()):
+                    if task in done:
+                        item = task.result()
+                        gets[name] = loop.create_task(
+                            sources[name].get(), name=f"core-{name}"
+                        )
+                        await self._handle(name, item)
+        finally:
+            for task in gets.values():
+                task.cancel()

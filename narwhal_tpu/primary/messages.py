@@ -1,0 +1,313 @@
+"""Protocol messages of the DAG: Header, Vote, Certificate.
+
+Reference primary/src/messages.rs (256 LoC).  Deterministic hashing rules
+(SHA-512/32B over canonical field bytes, maps/sets iterated sorted — BTreeMap
+semantics):
+- Header.id   = H(author ‖ round_le64 ‖ {digest ‖ worker_id_le32}* ‖ parents*)
+  (messages.rs:70-84)
+- Vote digest = H(header_id ‖ round_le64 ‖ origin)              (messages.rs:145-153)
+- Certificate digest = H(header_id ‖ round_le64 ‖ origin)       (messages.rs:226-234)
+
+Vote digest and certificate digest coincide by construction: every vote signs
+exactly the digest of the certificate it will be folded into, which is what
+makes quorum verification a single batched check over one message — the TPU
+vmap target (SURVEY.md §3.3).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Set, Tuple
+
+from ..config import Committee, WorkerId
+from ..crypto import Digest, PublicKey, Signature, sha512_digest, verify, verify_batch
+from ..messages import Round
+from ..utils.serde import Reader, Writer
+from .errors import (
+    AuthorityReuse,
+    CertificateRequiresQuorum,
+    InvalidHeaderId,
+    InvalidSignature,
+    UnknownAuthority,
+)
+
+# --- Header ------------------------------------------------------------------
+
+
+@dataclass
+class Header:
+    author: PublicKey
+    round: Round
+    payload: Dict[Digest, WorkerId]
+    parents: Set[Digest]
+    id: Digest = field(default_factory=Digest.zero)
+    signature: Signature = field(default_factory=Signature.default)
+
+    @classmethod
+    async def new(cls, author, round, payload, parents, signature_service) -> "Header":
+        header = cls(author=author, round=round, payload=payload, parents=set(parents))
+        header.id = header.compute_digest()
+        header.signature = await signature_service.request_signature(header.id)
+        return header
+
+    def compute_digest(self) -> Digest:
+        w = Writer()
+        w.raw(self.author)
+        w.u64(self.round)
+        for digest in sorted(self.payload):
+            w.raw(digest)
+            w.u32(self.payload[digest])
+        for parent in sorted(self.parents):
+            w.raw(parent)
+        return sha512_digest(w.finish())
+
+    def verify(self, committee: Committee) -> None:
+        """Reference messages.rs:48-67."""
+        if self.id != self.compute_digest():
+            raise InvalidHeaderId(f"header {self.id!r} id mismatch")
+        if committee.stake(self.author) <= 0:
+            raise UnknownAuthority(repr(self.author))
+        if not verify(bytes(self.id), self.author, self.signature):
+            raise InvalidSignature(f"header {self.id!r}")
+
+    def encode(self, w: Writer) -> None:
+        w.raw(self.author)
+        w.u64(self.round)
+        w.u32(len(self.payload))
+        for digest in sorted(self.payload):
+            w.raw(digest)
+            w.u32(self.payload[digest])
+        w.u32(len(self.parents))
+        for parent in sorted(self.parents):
+            w.raw(parent)
+        w.raw(self.id)
+        w.raw(self.signature)
+
+    @classmethod
+    def decode(cls, r: Reader) -> "Header":
+        author = PublicKey(r.raw(32))
+        round = r.u64()
+        payload = {}
+        for _ in range(r.u32()):
+            d = Digest(r.raw(32))
+            payload[d] = r.u32()
+        parents = {Digest(r.raw(32)) for _ in range(r.u32())}
+        id_ = Digest(r.raw(32))
+        signature = Signature(r.raw(64))
+        return cls(author, round, payload, parents, id_, signature)
+
+    def __repr__(self) -> str:
+        return f"{self.id!r}: B{self.round}({self.author!r})"
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Header) and self.id == other.id
+
+    def __hash__(self) -> int:
+        return hash(self.id)
+
+
+# --- Vote --------------------------------------------------------------------
+
+
+@dataclass
+class Vote:
+    id: Digest  # header id being voted for
+    round: Round
+    origin: PublicKey  # header author
+    author: PublicKey  # voter
+    signature: Signature = field(default_factory=Signature.default)
+
+    @classmethod
+    async def new(cls, header: Header, author: PublicKey, signature_service) -> "Vote":
+        vote = cls(id=header.id, round=header.round, origin=header.author, author=author)
+        vote.signature = await signature_service.request_signature(vote.digest())
+        return vote
+
+    def digest(self) -> Digest:
+        w = Writer()
+        w.raw(self.id)
+        w.u64(self.round)
+        w.raw(self.origin)
+        return sha512_digest(w.finish())
+
+    def verify(self, committee: Committee) -> None:
+        if committee.stake(self.author) <= 0:
+            raise UnknownAuthority(repr(self.author))
+        if not verify(bytes(self.digest()), self.author, self.signature):
+            raise InvalidSignature(f"vote by {self.author!r}")
+
+    def encode(self, w: Writer) -> None:
+        w.raw(self.id)
+        w.u64(self.round)
+        w.raw(self.origin)
+        w.raw(self.author)
+        w.raw(self.signature)
+
+    @classmethod
+    def decode(cls, r: Reader) -> "Vote":
+        return cls(
+            Digest(r.raw(32)),
+            r.u64(),
+            PublicKey(r.raw(32)),
+            PublicKey(r.raw(32)),
+            Signature(r.raw(64)),
+        )
+
+    def __repr__(self) -> str:
+        return f"{self.digest()!r}: V{self.round}({self.author!r}, {self.id!r})"
+
+
+# --- Certificate -------------------------------------------------------------
+
+
+@dataclass
+class Certificate:
+    header: Header
+    votes: List[Tuple[PublicKey, Signature]] = field(default_factory=list)
+
+    @property
+    def round(self) -> Round:
+        return self.header.round
+
+    @property
+    def origin(self) -> PublicKey:
+        return self.header.author
+
+    def digest(self) -> Digest:
+        w = Writer()
+        w.raw(self.header.id)
+        w.u64(self.round)
+        w.raw(self.origin)
+        return sha512_digest(w.finish())
+
+    def verify(self, committee: Committee) -> None:
+        """Quorum + batched signature check (reference messages.rs:189-215).
+        The batched call is the #1 crypto hot loop — the TPU backend verifies
+        all 2f+1 signatures in one device dispatch."""
+        if self in genesis(committee):
+            return
+        self.header.verify(committee)
+        weight = 0
+        used = set()
+        for name, _ in self.votes:
+            if name in used:
+                raise AuthorityReuse(repr(name))
+            stake = committee.stake(name)
+            if stake <= 0:
+                raise UnknownAuthority(repr(name))
+            used.add(name)
+            weight += stake
+        if weight < committee.quorum_threshold():
+            raise CertificateRequiresQuorum(repr(self.digest()))
+        if not verify_batch(
+            self.digest(), [n for n, _ in self.votes], [s for _, s in self.votes]
+        ):
+            raise InvalidSignature(f"certificate {self.digest()!r}")
+
+    def encode(self, w: Writer) -> None:
+        self.header.encode(w)
+        w.u32(len(self.votes))
+        for name, sig in self.votes:
+            w.raw(name)
+            w.raw(sig)
+
+    @classmethod
+    def decode(cls, r: Reader) -> "Certificate":
+        header = Header.decode(r)
+        votes = []
+        for _ in range(r.u32()):
+            votes.append((PublicKey(r.raw(32)), Signature(r.raw(64))))
+        return cls(header, votes)
+
+    def serialize(self) -> bytes:
+        w = Writer()
+        self.encode(w)
+        return w.finish()
+
+    @classmethod
+    def deserialize(cls, data: bytes) -> "Certificate":
+        r = Reader(data)
+        cert = cls.decode(r)
+        r.expect_done()
+        return cert
+
+    def __repr__(self) -> str:
+        return f"{self.digest()!r}: C{self.round}({self.origin!r}, {self.header.id!r})"
+
+    def __eq__(self, other) -> bool:
+        # Round and origin MUST participate: genesis certificates have a
+        # zero header id and no votes, so id+votes alone would let a forged
+        # non-zero-round certificate compare equal to genesis and skip
+        # verification entirely (reference messages.rs:249-256 compares
+        # round() and origin() for exactly this reason).
+        return (
+            isinstance(other, Certificate)
+            and self.header == other.header
+            and self.round == other.round
+            and self.origin == other.origin
+            and self.votes == other.votes
+        )
+
+
+def genesis(committee: Committee) -> List[Certificate]:
+    """One unsigned certificate per authority at round 0
+    (reference messages.rs:175-187)."""
+    return [
+        Certificate(header=Header(author=name, round=0, payload={}, parents=set()))
+        for name in committee.authorities
+    ]
+
+
+# --- primary ↔ primary wire frames ------------------------------------------
+
+PM_HEADER = 0
+PM_VOTE = 1
+PM_CERTIFICATE = 2
+PM_CERTIFICATES_REQUEST = 3
+
+
+def encode_primary_message(obj) -> bytes:
+    w = Writer()
+    if isinstance(obj, Header):
+        w.u8(PM_HEADER)
+        obj.encode(w)
+    elif isinstance(obj, Vote):
+        w.u8(PM_VOTE)
+        obj.encode(w)
+    elif isinstance(obj, Certificate):
+        w.u8(PM_CERTIFICATE)
+        obj.encode(w)
+    else:
+        raise TypeError(type(obj))
+    return w.finish()
+
+
+def encode_certificates_request(digests: List[Digest], requestor: PublicKey) -> bytes:
+    w = Writer()
+    w.u8(PM_CERTIFICATES_REQUEST)
+    w.u32(len(digests))
+    for d in digests:
+        w.raw(d)
+    w.raw(requestor)
+    return w.finish()
+
+
+def decode_primary_message(data: bytes):
+    """Returns ("header", Header) | ("vote", Vote) | ("certificate", Certificate)
+    | ("certificates_request", digests, requestor)."""
+    r = Reader(data)
+    tag = r.u8()
+    if tag == PM_HEADER:
+        out = ("header", Header.decode(r))
+    elif tag == PM_VOTE:
+        out = ("vote", Vote.decode(r))
+    elif tag == PM_CERTIFICATE:
+        out = ("certificate", Certificate.decode(r))
+    elif tag == PM_CERTIFICATES_REQUEST:
+        digests = [Digest(r.raw(32)) for _ in range(r.u32())]
+        requestor = PublicKey(r.raw(32))
+        out = ("certificates_request", digests, requestor)
+    else:
+        raise ValueError(f"unknown PrimaryMessage tag {tag}")
+    r.expect_done()
+    return out

@@ -1,0 +1,215 @@
+"""Primary wiring: receivers, channels, and the eight protocol tasks.
+
+Reference primary/src/primary.rs (275 LoC): builds the channels, spawns
+network receivers for primary↔primary (WAN) and worker→primary (LAN)
+traffic, and wires Core, GarbageCollector, PayloadReceiver, HeaderWaiter,
+CertificateWaiter, Proposer and Helper around the shared store and the
+atomic consensus round.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import List
+
+from ..config import Committee, Parameters
+from ..crypto import KeyPair, SignatureService
+from ..messages import decode_worker_primary_message
+from ..network import Receiver, Writer
+from ..store import Store
+from .certificate_waiter import CertificateWaiter
+from .core import AtomicRound, Core
+from .garbage_collector import GarbageCollector
+from .header_waiter import HeaderWaiter
+from .helper import Helper
+from .messages import decode_primary_message
+from .payload_receiver import PayloadReceiver
+from .proposer import Proposer
+from .synchronizer import Synchronizer
+
+log = logging.getLogger("narwhal.primary")
+
+CHANNEL_CAPACITY = 1_000
+
+
+class PrimaryReceiverHandler:
+    """primary↔primary plane: ACK, then route to Core or Helper
+    (reference primary.rs:224-243)."""
+
+    def __init__(self, tx_primaries: asyncio.Queue, tx_helper: asyncio.Queue) -> None:
+        self.tx_primaries = tx_primaries
+        self.tx_helper = tx_helper
+
+    async def dispatch(self, writer: Writer, message: bytes) -> None:
+        try:
+            decoded = decode_primary_message(message)
+        except ValueError as e:
+            log.warning("Dropping malformed primary message: %s", e)
+            return
+        await writer.send(b"Ack")
+        if decoded[0] == "certificates_request":
+            await self.tx_helper.put((decoded[1], decoded[2]))
+        else:
+            await self.tx_primaries.put(decoded)
+
+
+class WorkerReceiverHandler:
+    """worker→primary LAN plane: OurBatch → Proposer, OthersBatch →
+    PayloadReceiver (reference primary.rs:246-261)."""
+
+    def __init__(self, tx_our_digests: asyncio.Queue, tx_others_digests: asyncio.Queue) -> None:
+        self.tx_our_digests = tx_our_digests
+        self.tx_others_digests = tx_others_digests
+
+    async def dispatch(self, writer: Writer, message: bytes) -> None:
+        try:
+            decoded = decode_worker_primary_message(message)
+        except ValueError as e:
+            log.warning("Dropping malformed worker message: %s", e)
+            return
+        if decoded.ours:
+            await self.tx_our_digests.put((decoded.digest, decoded.worker_id))
+        else:
+            await self.tx_others_digests.put((decoded.digest, decoded.worker_id))
+
+
+class Primary:
+    def __init__(self) -> None:
+        self.tasks: List[asyncio.Task] = []
+        self.receivers: List[Receiver] = []
+        self.senders: List = []
+        self.tx_consensus: asyncio.Queue | None = None
+        self.rx_consensus: asyncio.Queue | None = None
+
+    @classmethod
+    async def spawn(
+        cls,
+        keypair: KeyPair,
+        committee: Committee,
+        parameters: Parameters,
+        store: Store,
+        tx_consensus: asyncio.Queue,
+        rx_consensus: asyncio.Queue,
+        benchmark: bool = False,
+    ) -> "Primary":
+        """`tx_consensus` carries fresh certificates to the consensus task;
+        `rx_consensus` brings committed certificates back for GC."""
+        self = cls()
+        name = keypair.name
+        loop = asyncio.get_running_loop()
+        q = lambda: asyncio.Queue(maxsize=CHANNEL_CAPACITY)  # noqa: E731
+
+        tx_primaries = q()  # network → core
+        tx_helper = q()
+        rx_our_digests = q()  # workers → proposer
+        rx_others_digests = q()  # workers → payload receiver
+        tx_headers_sync = q()  # synchronizer → header waiter
+        tx_certs_sync = q()  # synchronizer → certificate waiter
+        tx_headers_loopback = q()  # header waiter → core
+        tx_certs_loopback = q()  # certificate waiter → core
+        tx_proposer = q()  # core → proposer (parents, round)
+        tx_own_headers = q()  # proposer → core
+
+        consensus_round = AtomicRound()
+        signature_service = SignatureService(keypair)
+        synchronizer = Synchronizer(
+            name, committee, store, tx_headers_sync, tx_certs_sync
+        )
+
+        addrs = committee.primary(name)
+        self.receivers.append(
+            await Receiver.spawn(
+                addrs.primary_to_primary,
+                PrimaryReceiverHandler(tx_primaries, tx_helper),
+            )
+        )
+        self.receivers.append(
+            await Receiver.spawn(
+                addrs.worker_to_primary,
+                WorkerReceiverHandler(rx_our_digests, rx_others_digests),
+            )
+        )
+
+        core = Core(
+            name,
+            committee,
+            store,
+            synchronizer,
+            signature_service,
+            consensus_round,
+            parameters.gc_depth,
+            rx_primaries=tx_primaries,
+            rx_header_waiter=tx_headers_loopback,
+            rx_certificate_waiter=tx_certs_loopback,
+            rx_proposer=tx_own_headers,
+            tx_consensus=tx_consensus,
+            tx_proposer=tx_proposer,
+        )
+        garbage_collector = GarbageCollector(
+            name, committee, consensus_round, rx_consensus
+        )
+        payload_receiver = PayloadReceiver(store, rx_others_digests)
+        header_waiter = HeaderWaiter(
+            name,
+            committee,
+            store,
+            consensus_round,
+            parameters.gc_depth,
+            parameters.sync_retry_delay,
+            parameters.sync_retry_nodes,
+            rx_synchronizer=tx_headers_sync,
+            tx_core=tx_headers_loopback,
+        )
+        certificate_waiter = CertificateWaiter(
+            store,
+            consensus_round,
+            parameters.gc_depth,
+            rx_synchronizer=tx_certs_sync,
+            tx_core=tx_certs_loopback,
+        )
+        proposer = Proposer(
+            name,
+            committee,
+            signature_service,
+            parameters.header_size,
+            parameters.max_header_delay,
+            rx_core=tx_proposer,
+            rx_workers=rx_our_digests,
+            tx_core=tx_own_headers,
+            benchmark=benchmark,
+        )
+        helper = Helper(committee, store, tx_helper)
+
+        for runner in (
+            core,
+            garbage_collector,
+            payload_receiver,
+            header_waiter,
+            certificate_waiter,
+            proposer,
+            helper,
+        ):
+            self.tasks.append(loop.create_task(runner.run()))
+        self.senders = [
+            core.network,
+            garbage_collector.sender,
+            header_waiter.sender,
+            helper.sender,
+        ]
+
+        log.info(
+            "Primary %r successfully booted on %s",
+            name,
+            addrs.primary_to_primary.rsplit(":", 1)[0],
+        )
+        return self
+
+    async def shutdown(self) -> None:
+        for task in self.tasks:
+            task.cancel()
+        for sender in self.senders:
+            sender.close()
+        for receiver in self.receivers:
+            await receiver.shutdown()
+        await asyncio.gather(*self.tasks, return_exceptions=True)

@@ -1,0 +1,101 @@
+"""Proposer: owns the round counter and mints signed headers.
+
+Reference primary/src/proposer.rs (155 LoC): starts at round 1 with genesis
+parents; creates a header whenever it has parents AND (payload ≥ header_size
+OR max_header_delay elapsed); round advances when the Core delivers a quorum
+of certificates for the current round.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import List, Tuple
+
+from ..config import Committee, WorkerId
+from ..crypto import Digest, PublicKey, SignatureService
+from ..messages import Round
+from .messages import Header, genesis
+
+log = logging.getLogger("narwhal.primary")
+
+
+class Proposer:
+    def __init__(
+        self,
+        name: PublicKey,
+        committee: Committee,
+        signature_service: SignatureService,
+        header_size: int,
+        max_header_delay_ms: int,
+        rx_core: asyncio.Queue,  # (parent digests, round)
+        rx_workers: asyncio.Queue,  # (digest, worker_id)
+        tx_core: asyncio.Queue,  # Header
+        benchmark: bool = False,
+    ) -> None:
+        self.name = name
+        self.signature_service = signature_service
+        self.header_size = header_size
+        self.max_header_delay = max_header_delay_ms / 1000.0
+        self.rx_core = rx_core
+        self.rx_workers = rx_workers
+        self.tx_core = tx_core
+        self.benchmark = benchmark
+
+        self.round: Round = 1
+        self.last_parents: List[Digest] = [c.digest() for c in genesis(committee)]
+        self.digests: List[Tuple[Digest, WorkerId]] = []
+        self.payload_size = 0
+
+    async def _make_header(self) -> None:
+        payload = dict(self.digests)
+        self.digests = []
+        parents, self.last_parents = self.last_parents, []
+        header = await Header.new(
+            self.name, self.round, payload, parents, self.signature_service
+        )
+        log.debug("Created %r", header)
+        if self.benchmark:
+            for digest in header.payload:
+                # Parsed by the benchmark log parser to attribute batches to
+                # rounds (reference proposer.rs:93-97).
+                log.info("Created B%d(%r) -> %r", header.round, header.id, digest)
+        await self.tx_core.put(header)
+
+    async def run(self) -> None:
+        log.debug("Dag starting at round %d", self.round)
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + self.max_header_delay
+        core_get = loop.create_task(self.rx_core.get())
+        workers_get = loop.create_task(self.rx_workers.get())
+        try:
+            while True:
+                timer_expired = loop.time() >= deadline
+                enough_digests = self.payload_size >= self.header_size
+                if (timer_expired or enough_digests) and self.last_parents:
+                    await self._make_header()
+                    self.payload_size = 0
+                    deadline = loop.time() + self.max_header_delay
+
+                timeout = max(0.0, deadline - loop.time())
+                done, _ = await asyncio.wait(
+                    {core_get, workers_get},
+                    timeout=timeout,
+                    return_when=asyncio.FIRST_COMPLETED,
+                )
+                if core_get in done:
+                    parents, round = core_get.result()
+                    core_get = loop.create_task(self.rx_core.get())
+                    if round >= self.round:
+                        # Advance to the next round.
+                        self.round = round + 1
+                        log.debug("Dag moved to round %d", self.round)
+                        self.last_parents = parents
+                if workers_get in done:
+                    digest, worker_id = workers_get.result()
+                    workers_get = loop.create_task(self.rx_workers.get())
+                    self.payload_size += len(digest)
+                    self.digests.append((digest, worker_id))
+        finally:
+            core_get.cancel()
+            workers_get.cancel()

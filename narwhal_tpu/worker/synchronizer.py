@@ -62,6 +62,9 @@ class Synchronizer:
                     self._cleanup(cmd[1])
         finally:
             timer.cancel()
+            for task in self._waiters.values():
+                task.cancel()
+            self._waiters.clear()
 
     async def _synchronize(self, digests, target: PublicKey) -> None:
         missing = []

@@ -93,3 +93,51 @@ class RecordingAckHandler:
         self.arrived.set()
         if self.ack:
             await writer.send(b"Ack")
+
+
+# --- primary-plane fixtures (analog of reference primary/src/tests/common.rs) ---
+
+from narwhal_tpu.primary.messages import Certificate, Header, Vote, genesis  # noqa: E402
+
+
+def make_header(author_kp, round_=1, payload=None, parents=None, c=None):
+    """A signed header; parents default to the genesis certificates."""
+    c = c or committee()
+    parents = parents if parents is not None else {x.digest() for x in genesis(c)}
+    h = Header(
+        author=author_kp.name,
+        round=round_,
+        payload=payload or {},
+        parents=set(parents),
+    )
+    h.id = h.compute_digest()
+    h.signature = author_kp.sign(h.id)
+    return h
+
+
+def make_headers(round_=1, parents=None, c=None):
+    return [make_header(kp, round_, None, parents, c) for kp in keys()]
+
+
+def make_vote(header, voter_kp):
+    v = Vote(
+        id=header.id,
+        round=header.round,
+        origin=header.author,
+        author=voter_kp.name,
+    )
+    v.signature = voter_kp.sign(v.digest())
+    return v
+
+
+def make_votes(header, exclude_author=True):
+    kps = [kp for kp in keys() if not exclude_author or kp.name != header.author]
+    return [make_vote(header, kp) for kp in kps]
+
+
+def make_certificate(header):
+    """Certificate with votes from every authority except the author
+    (3 votes = quorum in the 4-node fixture)."""
+    return Certificate(header=header, votes=[
+        (v.author, v.signature) for v in make_votes(header)
+    ])

@@ -1,0 +1,155 @@
+"""Header/Vote/Certificate hashing, signing, verification, wire roundtrips."""
+
+import pytest
+
+from narwhal_tpu.crypto import Signature
+from narwhal_tpu.primary.errors import (
+    CertificateRequiresQuorum,
+    InvalidHeaderId,
+    InvalidSignature,
+    UnknownAuthority,
+)
+from narwhal_tpu.primary.messages import (
+    Certificate,
+    decode_primary_message,
+    encode_certificates_request,
+    encode_primary_message,
+    genesis,
+)
+from tests.common import (
+    committee,
+    keys,
+    make_certificate,
+    make_header,
+    make_vote,
+)
+
+
+def test_header_digest_deterministic():
+    kp = keys()[0]
+    a = make_header(kp)
+    b = make_header(kp)
+    assert a.id == b.id
+    c2 = make_header(kp, round_=2, parents=a.parents)
+    assert c2.id != a.id
+
+
+def test_header_verify():
+    c = committee()
+    h = make_header(keys()[0])
+    h.verify(c)  # no raise
+
+
+def test_header_verify_rejects_tampered_id():
+    c = committee()
+    h = make_header(keys()[0])
+    h.round = 99  # id no longer matches content
+    with pytest.raises(InvalidHeaderId):
+        h.verify(c)
+
+
+def test_header_verify_rejects_bad_signature():
+    c = committee()
+    h = make_header(keys()[0])
+    h.signature = Signature.default()
+    with pytest.raises(InvalidSignature):
+        h.verify(c)
+
+
+def test_vote_verify():
+    c = committee()
+    h = make_header(keys()[0])
+    v = make_vote(h, keys()[1])
+    v.verify(c)
+    v.signature = Signature.default()
+    with pytest.raises(InvalidSignature):
+        v.verify(c)
+
+
+def test_certificate_verify_quorum():
+    c = committee()
+    cert = make_certificate(make_header(keys()[0]))
+    cert.verify(c)  # 3 votes = quorum
+
+
+def test_certificate_rejects_insufficient_quorum():
+    c = committee()
+    cert = make_certificate(make_header(keys()[0]))
+    cert.votes = cert.votes[:1]
+    with pytest.raises(CertificateRequiresQuorum):
+        cert.verify(c)
+
+
+def test_certificate_rejects_forged_vote():
+    c = committee()
+    cert = make_certificate(make_header(keys()[0]))
+    name, _ = cert.votes[0]
+    cert.votes[0] = (name, Signature.default())
+    with pytest.raises(InvalidSignature):
+        cert.verify(c)
+
+
+def test_certificate_rejects_unknown_voter():
+    from narwhal_tpu.crypto import KeyPair
+
+    c = committee()
+    cert = make_certificate(make_header(keys()[0]))
+    outsider = KeyPair.generate(bytes([99]) * 32)
+    cert.votes[0] = (outsider.name, cert.votes[0][1])
+    with pytest.raises(UnknownAuthority):
+        cert.verify(c)
+
+
+def test_genesis_always_valid():
+    c = committee()
+    for cert in genesis(c):
+        cert.verify(c)
+    assert len({x.digest() for x in genesis(c)}) == 4  # distinct per authority
+
+
+def test_wire_roundtrips():
+    h = make_header(keys()[0], payload={})
+    for obj in (h, make_vote(h, keys()[1]), make_certificate(h)):
+        decoded = decode_primary_message(encode_primary_message(obj))
+        if decoded[0] == "header":
+            assert decoded[1].id == h.id and decoded[1].signature == h.signature
+        elif decoded[0] == "vote":
+            assert decoded[1].digest() == obj.digest()
+        else:
+            assert decoded[1] == obj
+
+    digests = [make_certificate(h).digest()]
+    kind, ds, req = decode_primary_message(
+        encode_certificates_request(digests, keys()[2].name)
+    )
+    assert kind == "certificates_request" and ds == digests and req == keys()[2].name
+
+
+def test_certificate_store_roundtrip():
+    cert = make_certificate(make_header(keys()[0]))
+    assert Certificate.deserialize(cert.serialize()) == cert
+
+
+def test_forged_genesis_lookalike_rejected():
+    """A certificate with zero header id and no votes must NOT pass as
+    genesis when its round is non-zero (safety: would skip all signature
+    checks). Reference messages.rs:249-256."""
+    from narwhal_tpu.crypto import Digest
+    from narwhal_tpu.primary.messages import Header
+    from narwhal_tpu.primary.errors import DagError
+
+    c = committee()
+    honest = keys()[1].name
+    forged = Certificate(
+        header=Header(
+            author=honest,
+            round=7,
+            payload={},
+            parents={x.digest() for x in genesis(c)},
+            id=Digest.zero(),
+            signature=Signature.default(),
+        ),
+        votes=[],
+    )
+    with pytest.raises(DagError):
+        forged.verify(c)

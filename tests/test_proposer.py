@@ -1,0 +1,93 @@
+"""Proposer tests (analog of reference proposer_tests.rs:7-68): empty header
+on timeout; payload header by size."""
+
+import asyncio
+
+import pytest
+
+from narwhal_tpu.crypto import SignatureService, sha512_digest
+from narwhal_tpu.primary.messages import genesis
+from narwhal_tpu.primary.proposer import Proposer
+from tests.common import committee, keys
+
+
+@pytest.fixture
+def run():
+    def _run(coro):
+        return asyncio.run(asyncio.wait_for(coro, 15))
+
+    return _run
+
+
+def make_proposer(c, kp, header_size=1_000, delay_ms=50):
+    rx_core, rx_workers, tx_core = (
+        asyncio.Queue(),
+        asyncio.Queue(),
+        asyncio.Queue(),
+    )
+    p = Proposer(
+        kp.name,
+        c,
+        SignatureService(kp),
+        header_size,
+        delay_ms,
+        rx_core,
+        rx_workers,
+        tx_core,
+    )
+    return p, rx_core, rx_workers, tx_core
+
+
+def test_empty_header_on_timeout(run):
+    async def go():
+        c = committee()
+        kp = keys()[0]
+        p, _, _, tx_core = make_proposer(c, kp, header_size=1_000, delay_ms=50)
+        task = asyncio.ensure_future(p.run())
+        header = await asyncio.wait_for(tx_core.get(), 5)
+        assert header.round == 1 and header.payload == {}
+        assert header.parents == {x.digest() for x in genesis(c)}
+        header.verify(c)
+        task.cancel()
+
+    run(go())
+
+
+def test_payload_header_by_size(run):
+    async def go():
+        c = committee()
+        kp = keys()[0]
+        # Huge delay: sealing must be triggered by payload size alone.
+        p, _, rx_workers, tx_core = make_proposer(
+            c, kp, header_size=32, delay_ms=60_000
+        )
+        task = asyncio.ensure_future(p.run())
+        digest = sha512_digest(b"batch")
+        await rx_workers.put((digest, 3))
+        header = await asyncio.wait_for(tx_core.get(), 5)
+        assert header.payload == {digest: 3} and header.round == 1
+        header.verify(c)
+        task.cancel()
+
+    run(go())
+
+
+def test_round_advance_requires_parents(run):
+    async def go():
+        c = committee()
+        kp = keys()[0]
+        p, rx_core, _, tx_core = make_proposer(c, kp, header_size=1_000, delay_ms=50)
+        task = asyncio.ensure_future(p.run())
+        first = await asyncio.wait_for(tx_core.get(), 5)
+        assert first.round == 1
+        # No parents delivered: proposer must NOT mint round-2 headers.
+        await asyncio.sleep(0.3)
+        assert tx_core.empty()
+        # Parents for round 1 arrive: round advances and a header appears.
+        parents = [sha512_digest(bytes([i]) * 3) for i in range(3)]
+        await rx_core.put((parents, 1))
+        second = await asyncio.wait_for(tx_core.get(), 5)
+        assert second.round == 2 and second.parents == set(parents)
+        task.cancel()
+
+    run(go())
