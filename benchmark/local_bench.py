@@ -1,0 +1,250 @@
+"""Local benchmark: run a full committee + clients on localhost and measure.
+
+Reference benchmark/benchmark/local.py (`fab local`): generate keys/committee/
+parameters files, launch every primary/worker/client as its own OS process,
+run for `duration` seconds, kill, parse logs, print the summary.
+
+    python benchmark/local_bench.py --nodes 4 --workers 1 --rate 20000 \
+        --tx-size 512 --duration 20
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from narwhal_tpu.config import (  # noqa: E402
+    Authority,
+    Committee,
+    Parameters,
+    PrimaryAddresses,
+    WorkerAddresses,
+    export_keypair,
+)
+from narwhal_tpu.crypto import KeyPair  # noqa: E402
+from benchmark.logs import parse_logs  # noqa: E402
+
+
+def build_committee(keypairs, base_port, workers):
+    port = base_port
+    auths = {}
+    for kp in keypairs:
+        def nxt():
+            nonlocal port
+            a = f"127.0.0.1:{port}"
+            port += 1
+            return a
+
+        primary = PrimaryAddresses(nxt(), nxt())
+        ws = {
+            wid: WorkerAddresses(nxt(), nxt(), nxt()) for wid in range(workers)
+        }
+        auths[kp.name] = Authority(stake=1, primary=primary, workers=ws)
+    return Committee(auths)
+
+
+def run_bench(
+    nodes: int = 4,
+    workers: int = 1,
+    rate: int = 20_000,
+    tx_size: int = 512,
+    duration: int = 20,
+    base_port: int = 7000,
+    faults: int = 0,
+    header_size: int = 1_000,
+    batch_size: int = 500_000,
+    max_header_delay: int = 100,
+    max_batch_delay: int = 100,
+    workdir: str = None,
+    keep_logs: bool = False,
+    quiet: bool = False,
+):
+    workdir = workdir or os.path.join(REPO, ".bench")
+    shutil.rmtree(workdir, ignore_errors=True)
+    os.makedirs(workdir, exist_ok=True)
+
+    keypairs = [KeyPair.generate() for _ in range(nodes)]
+    committee = build_committee(keypairs, base_port, workers)
+    committee.export(f"{workdir}/committee.json")
+    params = Parameters(
+        header_size=header_size,
+        batch_size=batch_size,
+        max_header_delay=max_header_delay,
+        max_batch_delay=max_batch_delay,
+    )
+    params.export(f"{workdir}/parameters.json")
+    for i, kp in enumerate(keypairs):
+        export_keypair(kp, f"{workdir}/node-{i}.json")
+
+    env = dict(os.environ, PYTHONPATH=REPO)
+    procs = []
+    primary_logs, worker_logs, client_logs = [], [], []
+
+    def spawn(cmd, logfile):
+        f = open(logfile, "w")
+        p = subprocess.Popen(
+            cmd, stdout=f, stderr=subprocess.STDOUT, env=env, cwd=REPO
+        )
+        procs.append((p, f))
+        return p
+
+    alive = nodes - faults  # crash faults: the last `faults` nodes never boot
+    for i in range(alive):
+        log = f"{workdir}/primary-{i}.log"
+        primary_logs.append(log)
+        spawn(
+            [
+                sys.executable,
+                "-m",
+                "narwhal_tpu.node",
+                "-v",
+                "run",
+                "--keys",
+                f"{workdir}/node-{i}.json",
+                "--committee",
+                f"{workdir}/committee.json",
+                "--parameters",
+                f"{workdir}/parameters.json",
+                "--store",
+                f"{workdir}/db-primary-{i}",
+                "--benchmark",
+                "primary",
+            ],
+            log,
+        )
+        for wid in range(workers):
+            log = f"{workdir}/worker-{i}-{wid}.log"
+            worker_logs.append(log)
+            spawn(
+                [
+                    sys.executable,
+                    "-m",
+                    "narwhal_tpu.node",
+                    "-v",
+                    "run",
+                    "--keys",
+                    f"{workdir}/node-{i}.json",
+                    "--committee",
+                    f"{workdir}/committee.json",
+                    "--parameters",
+                    f"{workdir}/parameters.json",
+                    "--store",
+                    f"{workdir}/db-worker-{i}-{wid}",
+                    "--benchmark",
+                    "worker",
+                    "--id",
+                    str(wid),
+                ],
+                log,
+            )
+
+    # One client per live worker, rate split evenly (reference local.py:78).
+    committee_obj = committee
+    rate_share = max(1, rate // max(1, alive * workers))
+    client_idx = 0
+    for i in range(alive):
+        kp = keypairs[i]
+        for wid in range(workers):
+            addr = committee_obj.worker(kp.name, wid).transactions
+            log = f"{workdir}/client-{i}-{wid}.log"
+            client_logs.append(log)
+            spawn(
+                [
+                    sys.executable,
+                    "-m",
+                    "narwhal_tpu.node.benchmark_client",
+                    addr,
+                    "--size",
+                    str(tx_size),
+                    "--rate",
+                    str(rate_share),
+                    "--sample-offset",
+                    str(client_idx << 32),
+                    "--nodes",
+                    addr,
+                ],
+                log,
+            )
+            client_idx += 1
+
+    if not quiet:
+        print(f"Running benchmark ({duration} s)...", file=sys.stderr)
+    time.sleep(duration)
+
+    for p, f in procs:
+        try:
+            p.send_signal(signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+    for p, f in procs:
+        p.wait()
+        f.close()
+
+    read = lambda paths: [open(p).read() for p in paths]  # noqa: E731
+    result = parse_logs(
+        read(client_logs), read(worker_logs), read(primary_logs), tx_size
+    )
+    if not keep_logs:
+        for i in range(alive):
+            shutil.rmtree(f"{workdir}/db-primary-{i}", ignore_errors=True)
+            for wid in range(workers):
+                shutil.rmtree(
+                    f"{workdir}/db-worker-{i}-{wid}", ignore_errors=True
+                )
+    return result
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--nodes", type=int, default=4)
+    parser.add_argument("--workers", type=int, default=1)
+    parser.add_argument("--rate", type=int, default=20_000)
+    parser.add_argument("--tx-size", type=int, default=512)
+    parser.add_argument("--duration", type=int, default=20)
+    parser.add_argument("--faults", type=int, default=0)
+    parser.add_argument("--base-port", type=int, default=7000)
+    parser.add_argument("--json", action="store_true")
+    args = parser.parse_args()
+
+    result = run_bench(
+        nodes=args.nodes,
+        workers=args.workers,
+        rate=args.rate,
+        tx_size=args.tx_size,
+        duration=args.duration,
+        faults=args.faults,
+        base_port=args.base_port,
+    )
+    if result.errors:
+        print("ERRORS detected in logs:", file=sys.stderr)
+        for e in result.errors[:10]:
+            print("  " + e, file=sys.stderr)
+        sys.exit(1)
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "consensus_tps": result.consensus_tps,
+                    "consensus_latency_ms": result.consensus_latency_ms,
+                    "end_to_end_tps": result.end_to_end_tps,
+                    "end_to_end_latency_ms": result.end_to_end_latency_ms,
+                    "committed_bytes": result.committed_bytes,
+                    "samples": result.samples,
+                }
+            )
+        )
+    else:
+        print(result.summary(args.rate, args.tx_size, args.nodes, args.workers))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,146 @@
+"""Log parser: turn node/client logs into TPS and latency numbers.
+
+Reference benchmark/benchmark/logs.py (259 LoC) — the measurement system:
+- consensus TPS   = committed batch bytes / (first batch creation → last
+                    commit) / tx size
+- consensus latency = commit time − batch creation time, averaged
+- end-to-end latency = sample-tx client-send → commit of its batch
+- hard-fails if any log contains an error marker (logs.py:98,138)
+
+Log lines joined (emitted by this framework under --benchmark):
+  client:    Sending sample transaction {id}
+  worker:    Batch {digest} contains sample tx {id}
+             Batch {digest} contains {n} B
+  primary:   Created B{round}({header}) -> {batch_digest}
+  consensus: Committed B{round}({header}) -> {batch_digest}
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from datetime import datetime
+from typing import Dict, List
+
+_TS = r"(\d{4}-\d{2}-\d{2}T\d{2}:\d{2}:\d{2}\.\d{3})Z"
+
+
+def _ts(s: str) -> float:
+    return datetime.strptime(s, "%Y-%m-%dT%H:%M:%S.%f").timestamp()
+
+
+class BenchError(Exception):
+    pass
+
+
+@dataclass
+class ParseResult:
+    consensus_tps: float = 0.0
+    consensus_bps: float = 0.0
+    consensus_latency_ms: float = 0.0
+    end_to_end_tps: float = 0.0
+    end_to_end_bps: float = 0.0
+    end_to_end_latency_ms: float = 0.0
+    committed_bytes: int = 0
+    committed_batches: int = 0
+    duration_s: float = 0.0
+    samples: int = 0
+    errors: List[str] = field(default_factory=list)
+
+    def summary(self, rate: int, tx_size: int, nodes: int, workers: int) -> str:
+        return (
+            "\n-----------------------------------------\n"
+            " SUMMARY:\n"
+            "-----------------------------------------\n"
+            " + CONFIG:\n"
+            f"   Committee size: {nodes} nodes\n"
+            f"   Workers per node: {workers}\n"
+            f"   Input rate: {rate:,} tx/s\n"
+            f"   Transaction size: {tx_size} B\n"
+            f"   Execution time: {self.duration_s:,.0f} s\n"
+            "\n + RESULTS:\n"
+            f"   Consensus TPS: {self.consensus_tps:,.0f} tx/s\n"
+            f"   Consensus BPS: {self.consensus_bps:,.0f} B/s\n"
+            f"   Consensus latency: {self.consensus_latency_ms:,.0f} ms\n"
+            "\n"
+            f"   End-to-end TPS: {self.end_to_end_tps:,.0f} tx/s\n"
+            f"   End-to-end BPS: {self.end_to_end_bps:,.0f} B/s\n"
+            f"   End-to-end latency: {self.end_to_end_latency_ms:,.0f} ms\n"
+            "-----------------------------------------\n"
+        )
+
+
+def parse_logs(
+    client_logs: List[str],
+    worker_logs: List[str],
+    primary_logs: List[str],
+    tx_size: int,
+) -> ParseResult:
+    result = ParseResult()
+
+    # Crash detection: any hard error in any log fails the run.
+    for text in client_logs + worker_logs + primary_logs:
+        for marker in ("ERROR", "CRITICAL", "Traceback", "panicked"):
+            if marker in text:
+                line = next(
+                    (ln for ln in text.splitlines() if marker in ln), marker
+                )
+                result.errors.append(line)
+
+    # Client: sample send times.
+    sample_sent: Dict[int, float] = {}
+    for text in client_logs:
+        for m in re.finditer(_TS + r".* Sending sample transaction (\d+)", text):
+            sample_sent.setdefault(int(m.group(2)), _ts(m.group(1)))
+
+    # Workers: batch creation time, size, contained samples.
+    batch_created: Dict[str, float] = {}
+    batch_bytes: Dict[str, int] = {}
+    batch_samples: Dict[str, List[int]] = {}
+    for text in worker_logs:
+        for m in re.finditer(_TS + r".* Batch (\S+) contains (\d+) B", text):
+            digest = m.group(2)
+            batch_created.setdefault(digest, _ts(m.group(1)))
+            batch_bytes.setdefault(digest, int(m.group(3)))
+        for m in re.finditer(_TS + r".* Batch (\S+) contains sample tx (\d+)", text):
+            batch_samples.setdefault(m.group(2), []).append(int(m.group(3)))
+
+    # Primaries: commit times (first node to commit wins the timestamp).
+    batch_committed: Dict[str, float] = {}
+    for text in primary_logs:
+        for m in re.finditer(_TS + r".* Committed B\d+\(\S+\) -> (\S+)", text):
+            t = _ts(m.group(1))
+            d = m.group(2)
+            if d not in batch_committed or t < batch_committed[d]:
+                batch_committed[d] = t
+
+    committed = [d for d in batch_committed if d in batch_created]
+    if not committed:
+        return result
+
+    result.committed_batches = len(committed)
+    result.committed_bytes = sum(batch_bytes.get(d, 0) for d in committed)
+    start = min(batch_created[d] for d in committed)
+    end = max(batch_committed[d] for d in committed)
+    duration = max(end - start, 1e-6)
+    result.duration_s = duration
+    result.consensus_bps = result.committed_bytes / duration
+    result.consensus_tps = result.consensus_bps / tx_size
+    lats = [batch_committed[d] - batch_created[d] for d in committed]
+    result.consensus_latency_ms = 1000 * sum(lats) / len(lats)
+
+    # End-to-end: join sample send → containing batch → commit.
+    e2e = []
+    for digest in committed:
+        for sample_id in batch_samples.get(digest, []):
+            sent = sample_sent.get(sample_id)
+            if sent is not None:
+                e2e.append(batch_committed[digest] - sent)
+    result.samples = len(e2e)
+    if e2e and sample_sent:
+        first_send = min(sample_sent.values())
+        e2e_duration = max(end - first_send, 1e-6)
+        result.end_to_end_bps = result.committed_bytes / e2e_duration
+        result.end_to_end_tps = result.end_to_end_bps / tx_size
+        result.end_to_end_latency_ms = 1000 * sum(e2e) / len(e2e)
+    return result

@@ -1,0 +1,3 @@
+from .tusk import Consensus, Tusk, State
+
+__all__ = ["Consensus", "Tusk", "State"]

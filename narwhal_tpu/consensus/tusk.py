@@ -1,0 +1,237 @@
+"""Tusk: zero-message asynchronous BFT commit over the shared DAG.
+
+Reference consensus/src/lib.rs (304 LoC).  Every even round r has a leader;
+when the leader of round r−2 gathers f+1 stake support among round r−1
+certificates, it commits — together with every preceding uncommitted leader
+it is linked to, each flattening its causal sub-DAG in deterministic order.
+No extra messages: the commit rule is a pure function of the DAG.
+
+The pure state machine (`Tusk.process_certificate`) is separated from the
+async runner (`Consensus`) so the commit rule can be golden-tested directly
+and later swapped for the JAX adjacency-matrix kernel
+(narwhal_tpu/ops/reachability.py) validated certificate-for-certificate
+against this implementation.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Dict, List, Optional, Tuple
+
+from ..config import Committee
+from ..crypto import Digest, PublicKey
+from ..messages import Round
+from ..primary.messages import Certificate, genesis
+
+log = logging.getLogger("narwhal.consensus")
+
+# dag: Round → {origin → (certificate digest, certificate)}
+Dag = Dict[Round, Dict[PublicKey, Tuple[Digest, Certificate]]]
+
+
+class State:
+    """Consensus state (reference lib.rs:19-62)."""
+
+    def __init__(self, genesis_certs: List[Certificate]) -> None:
+        gen = {c.origin: (c.digest(), c) for c in genesis_certs}
+        self.last_committed_round: Round = 0
+        self.last_committed: Dict[PublicKey, Round] = {
+            name: cert.round for name, (_, cert) in gen.items()
+        }
+        self.dag: Dag = {0: gen}
+
+    def update(self, certificate: Certificate, gc_depth: Round) -> None:
+        """Record a commit and garbage-collect the DAG window."""
+        origin = certificate.origin
+        self.last_committed[origin] = max(
+            self.last_committed.get(origin, 0), certificate.round
+        )
+        self.last_committed_round = max(self.last_committed.values())
+        last = self.last_committed_round
+        for name, round in self.last_committed.items():
+            for r in list(self.dag):
+                authorities = self.dag[r]
+                if name in authorities and r < round:
+                    del authorities[name]
+                if not authorities or r + gc_depth < last:
+                    del self.dag[r]
+
+
+class Tusk:
+    """The pure commit rule: feed certificates, get ordered commit batches."""
+
+    def __init__(
+        self, committee: Committee, gc_depth: Round, fixed_coin: bool = False
+    ) -> None:
+        self.committee = committee
+        self.gc_depth = gc_depth
+        # fixed_coin pins the leader to the first authority — the reference's
+        # #[cfg(test)] coin = 0 (lib.rs:209-212) used by the golden tests.
+        self.fixed_coin = fixed_coin
+        self.state = State(genesis(committee))
+        self._sorted_keys = sorted(committee.authorities.keys())
+
+    def leader(self, round: Round, dag: Dag) -> Optional[Tuple[Digest, Certificate]]:
+        """Round-robin leader (a common coin in the full protocol —
+        reference lib.rs:205-221)."""
+        coin = 0 if self.fixed_coin else round
+        name = self._sorted_keys[coin % len(self._sorted_keys)]
+        return dag.get(round, {}).get(name)
+
+    def process_certificate(self, certificate: Certificate) -> List[Certificate]:
+        """Insert a certificate; return the newly committed sequence
+        (possibly empty).  Reference lib.rs:105-201."""
+        state = self.state
+        round = certificate.round
+        state.dag.setdefault(round, {})[certificate.origin] = (
+            certificate.digest(),
+            certificate,
+        )
+
+        # Order from the highest round with a 2f+1 frontier (needed to
+        # reveal the common coin).  Leaders live on even rounds.
+        r = round - 1
+        if r % 2 != 0 or r < 4:
+            return []
+        leader_round = r - 2
+        if leader_round <= state.last_committed_round:
+            return []
+        got = self.leader(leader_round, state.dag)
+        if got is None:
+            return []
+        leader_digest, leader = got
+
+        # f+1 support among the children (round r-1 certificates).
+        stake = sum(
+            self.committee.stake(cert.origin)
+            for _, cert in state.dag.get(r - 1, {}).values()
+            if leader_digest in cert.header.parents
+        )
+        if stake < self.committee.validity_threshold():
+            log.debug("Leader %r does not have enough support", leader)
+            return []
+
+        # Commit every linked uncommitted leader, oldest first, each
+        # flattening its causal sub-DAG.
+        log.debug("Leader %r has enough support", leader)
+        sequence: List[Certificate] = []
+        for past_leader in reversed(self.order_leaders(leader)):
+            for x in self.order_dag(past_leader):
+                state.update(x, self.gc_depth)
+                sequence.append(x)
+        return sequence
+
+    def order_leaders(self, leader: Certificate) -> List[Certificate]:
+        """Walk back two rounds at a time, keeping leaders linked to the
+        chain (reference lib.rs:224-244)."""
+        to_commit = [leader]
+        state = self.state
+        for r in range(
+            leader.round - 2, state.last_committed_round + 1, -2
+        ):
+            got = self.leader(r, state.dag)
+            if got is None:
+                continue
+            _, prev_leader = got
+            if self.linked(leader, prev_leader, state.dag):
+                to_commit.append(prev_leader)
+                leader = prev_leader
+        return to_commit
+
+    def linked(
+        self, leader: Certificate, prev_leader: Certificate, dag: Dag
+    ) -> bool:
+        """Round-by-round BFS reachability (reference lib.rs:247-259).
+        This is the loop the TPU kernel re-expresses as boolean
+        adjacency-matrix products."""
+        parents = [leader]
+        for r in range(leader.round - 1, prev_leader.round - 1, -1):
+            parents = [
+                certificate
+                for digest, certificate in dag.get(r, {}).values()
+                if any(digest in x.header.parents for x in parents)
+            ]
+        return any(x is prev_leader or x == prev_leader for x in parents)
+
+    def order_dag(self, leader: Certificate) -> List[Certificate]:
+        """DFS flatten of the leader's causal history, skipping
+        already-committed certificates (reference lib.rs:263-303)."""
+        state = self.state
+        ordered: List[Certificate] = []
+        already_ordered = set()
+        buffer = [leader]
+        while buffer:
+            x = buffer.pop()
+            ordered.append(x)
+            # Sorted iteration (the reference's BTreeSet order): a Python
+            # set's iteration order depends on insertion history, which
+            # differs between the author's in-memory header and decoded
+            # copies — unsorted DFS would give each node a different
+            # intra-round commit order.
+            for parent in sorted(x.header.parents):
+                found = None
+                for digest, certificate in state.dag.get(x.round - 1, {}).values():
+                    if digest == parent:
+                        found = (digest, certificate)
+                        break
+                if found is None:
+                    continue  # already ordered or GC'd up to here
+                digest, certificate = found
+                skip = digest in already_ordered
+                skip |= (
+                    state.last_committed.get(certificate.origin)
+                    == certificate.round
+                )
+                if not skip:
+                    buffer.append(certificate)
+                    already_ordered.add(digest)
+        # Never commit garbage-collected certificates.
+        ordered = [
+            x
+            for x in ordered
+            if x.round + self.gc_depth >= state.last_committed_round
+        ]
+        ordered.sort(key=lambda x: x.round)  # stable: prettier sequence
+        return ordered
+
+
+class Consensus:
+    """Async runner: certificates in from the primary, ordered certificates
+    out to the application and back to the primary for GC."""
+
+    def __init__(
+        self,
+        committee: Committee,
+        gc_depth: Round,
+        rx_primary: asyncio.Queue,
+        tx_primary: asyncio.Queue,
+        tx_output: asyncio.Queue,
+        benchmark: bool = False,
+        fixed_coin: bool = False,
+    ) -> None:
+        self.tusk = Tusk(committee, gc_depth, fixed_coin=fixed_coin)
+        self.rx_primary = rx_primary
+        self.tx_primary = tx_primary
+        self.tx_output = tx_output
+        self.benchmark = benchmark
+
+    async def run(self) -> None:
+        while True:
+            certificate = await self.rx_primary.get()
+            for committed in self.tusk.process_certificate(certificate):
+                header = committed.header
+                if self.benchmark and header.payload:
+                    for digest in header.payload:
+                        # Parsed by the benchmark log parser (reference
+                        # lib.rs:185-189).
+                        log.info(
+                            "Committed B%d(%r) -> %r",
+                            header.round,
+                            header.id,
+                            digest,
+                        )
+                else:
+                    log.info("Committed B%d(%r)", header.round, header.id)
+                await self.tx_primary.put(committed)
+                await self.tx_output.put(committed)

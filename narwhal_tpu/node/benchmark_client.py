@@ -1,0 +1,110 @@
+"""Open-loop benchmark load generator.
+
+Reference node/src/benchmark_client.rs (158 LoC): send `rate` tx/s in
+PRECISION(=20) bursts per second over one connection; the first tx of each
+burst is a 'sample' (byte0=0 + u64 counter) logged for end-to-end latency
+measurement, the rest are filler (byte0=1 + random u64), all zero-padded to
+`size`.  Waits for all peer transaction sockets to accept before starting.
+
+    python -m narwhal_tpu.node.benchmark_client 127.0.0.1:7001 \
+        --size 512 --rate 50000 --nodes 127.0.0.1:7001 127.0.0.1:7006 ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import random
+import sys
+import time
+
+from ..network.framing import parse_address, write_frame
+
+log = logging.getLogger("narwhal.client")
+
+PRECISION = 20  # bursts per second
+BURST_DURATION = 1.0 / PRECISION
+
+
+async def wait_for(nodes) -> None:
+    """Block until every node's transaction socket accepts."""
+    log.info("Waiting for all nodes to be online...")
+    for address in nodes:
+        host, port = parse_address(address)
+        while True:
+            try:
+                _, w = await asyncio.open_connection(host, port)
+                w.close()
+                break
+            except OSError:
+                await asyncio.sleep(0.1)
+
+
+async def send_load(target: str, size: int, rate: int, sample_offset: int = 0) -> None:
+    if size < 9:
+        raise ValueError("Transaction size must be at least 9 bytes")
+    burst = max(1, rate // PRECISION)
+    host, port = parse_address(target)
+    _, writer = await asyncio.open_connection(host, port)
+    log.info("Start sending transactions")
+    log.info("Transactions size: %d B", size)
+    log.info("Transactions rate: %d tx/s", rate)
+
+    # Distinct offsets keep sample ids globally unique across clients so the
+    # log parser's send→commit join is unambiguous.
+    counter = sample_offset
+    rng = random.Random(sample_offset)
+    pad = bytes(size - 9)
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + BURST_DURATION
+    while True:
+        for x in range(burst):
+            if x == 0:
+                # One sample tx per burst — sent first so its logged send
+                # time excludes the burst's own queueing (reference
+                # benchmark_client.rs:258-271).
+                tx = b"\x00" + counter.to_bytes(8, "little") + pad
+                log.info("Sending sample transaction %d", counter)
+            else:
+                tx = b"\x01" + rng.getrandbits(64).to_bytes(8, "little") + pad
+            await write_frame(writer, tx)
+        counter += 1
+        now = loop.time()
+        if now > deadline:
+            log.warning("Transaction rate too high for this client")
+        else:
+            await asyncio.sleep(deadline - now)
+        deadline += BURST_DURATION
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description="Narwhal benchmark client")
+    parser.add_argument("target", help="ip:port of the worker tx socket")
+    parser.add_argument("--size", type=int, required=True)
+    parser.add_argument("--rate", type=int, required=True)
+    parser.add_argument("--nodes", nargs="*", default=[])
+    parser.add_argument("--sample-offset", type=int, default=0)
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s.%(msecs)03dZ %(levelname)s %(name)s %(message)s",
+        datefmt="%Y-%m-%dT%H:%M:%S",
+        stream=sys.stderr,
+        force=True,
+    )
+
+    async def run() -> None:
+        await wait_for(args.nodes or [args.target])
+        await send_load(args.target, args.size, args.rate, args.sample_offset)
+
+    try:
+        asyncio.run(run())
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,101 @@
+"""Node CLI (reference node/src/main.rs, 141 LoC).
+
+    python -m narwhal_tpu.node generate_keys --filename keys.json
+    python -m narwhal_tpu.node run --keys k.json --committee c.json \
+        [--parameters p.json] --store db primary
+    python -m narwhal_tpu.node run ... worker --id 0
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import sys
+
+from ..config import Committee, Parameters, export_keypair, load_keypair
+from ..crypto import KeyPair
+from .node import spawn_primary_node, spawn_worker_node
+
+
+def setup_logging(verbosity: int) -> None:
+    level = [logging.ERROR, logging.INFO, logging.DEBUG][min(verbosity, 2)]
+    # Millisecond timestamps: the benchmark log parser depends on them
+    # (reference main.rs:54-55).
+    logging.basicConfig(
+        level=level,
+        format="%(asctime)s.%(msecs)03dZ %(levelname)s %(name)s %(message)s",
+        datefmt="%Y-%m-%dT%H:%M:%S",
+        stream=sys.stderr,
+        force=True,
+    )
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="narwhal-tpu-node",
+        description="A TPU-native implementation of Narwhal and Tusk.",
+    )
+    parser.add_argument("-v", action="count", default=1, dest="verbosity")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    gen = sub.add_parser("generate_keys", help="Print a fresh keypair to file")
+    gen.add_argument("--filename", required=True)
+
+    run = sub.add_parser("run", help="Run a node")
+    run.add_argument("--keys", required=True)
+    run.add_argument("--committee", required=True)
+    run.add_argument("--parameters")
+    run.add_argument("--store", required=True)
+    run.add_argument("--benchmark", action="store_true", default=False)
+    runsub = run.add_subparsers(dest="role", required=True)
+    runsub.add_parser("primary", help="Run a single primary")
+    wrk = runsub.add_parser("worker", help="Run a single worker")
+    wrk.add_argument("--id", type=int, required=True)
+
+    args = parser.parse_args(argv)
+
+    if args.command == "generate_keys":
+        export_keypair(KeyPair.generate(), args.filename)
+        return 0
+
+    setup_logging(args.verbosity)
+    keypair = load_keypair(args.keys)
+    committee = Committee.load(args.committee)
+    parameters = (
+        Parameters.load(args.parameters) if args.parameters else Parameters()
+    )
+    parameters.log(logging.getLogger("narwhal.node"))
+
+    async def run_node() -> None:
+        if args.role == "primary":
+            node = await spawn_primary_node(
+                keypair,
+                committee,
+                parameters,
+                store_path=f"{args.store}/store.log",
+                benchmark=args.benchmark,
+            )
+        else:
+            node = await spawn_worker_node(
+                keypair,
+                args.id,
+                committee,
+                parameters,
+                store_path=f"{args.store}/store.log",
+                benchmark=args.benchmark,
+            )
+        try:
+            await asyncio.Event().wait()  # run forever
+        finally:
+            await node.shutdown()
+
+    try:
+        asyncio.run(run_node())
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,114 @@
+"""Node assembly: wire Primary + Consensus (+ application sink), or a Worker.
+
+Reference node/src/main.rs:69-141: `run … primary` spawns the Primary and
+the Consensus task joined by channels (the consensus output loops back to the
+primary's GarbageCollector); `run … worker --id N` spawns a Worker;
+`analyze()` is the application layer stub that consumes committed
+certificates.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Callable, List, Optional
+
+from ..config import Committee, Parameters, WorkerId
+from ..consensus import Consensus
+from ..crypto import KeyPair
+from ..primary import Primary
+from ..store import Store
+from ..worker import Worker
+
+log = logging.getLogger("narwhal.node")
+
+CHANNEL_CAPACITY = 1_000
+
+
+class PrimaryNode:
+    def __init__(self) -> None:
+        self.primary: Optional[Primary] = None
+        self.tasks: List[asyncio.Task] = []
+        self.store: Optional[Store] = None
+
+    async def shutdown(self) -> None:
+        for task in self.tasks:
+            task.cancel()
+        if self.primary is not None:
+            await self.primary.shutdown()
+        await asyncio.gather(*self.tasks, return_exceptions=True)
+        if self.store is not None:
+            self.store.close()
+
+
+async def spawn_primary_node(
+    keypair: KeyPair,
+    committee: Committee,
+    parameters: Parameters,
+    store_path: Optional[str] = None,
+    benchmark: bool = False,
+    on_commit: Optional[Callable] = None,
+) -> PrimaryNode:
+    """Primary + Consensus pair with the GC feedback loop.  `on_commit`
+    (sync callable) is the application layer — the reference's `analyze()`
+    stub (main.rs:137-141)."""
+    node = PrimaryNode()
+    loop = asyncio.get_running_loop()
+    node.store = Store(store_path)
+
+    tx_new_certificates = asyncio.Queue(maxsize=CHANNEL_CAPACITY)
+    tx_feedback = asyncio.Queue(maxsize=CHANNEL_CAPACITY)
+    tx_output = asyncio.Queue(maxsize=CHANNEL_CAPACITY)
+
+    node.primary = await Primary.spawn(
+        keypair,
+        committee,
+        parameters,
+        node.store,
+        tx_consensus=tx_new_certificates,
+        rx_consensus=tx_feedback,
+        benchmark=benchmark,
+    )
+    consensus = Consensus(
+        committee,
+        parameters.gc_depth,
+        rx_primary=tx_new_certificates,
+        tx_primary=tx_feedback,
+        tx_output=tx_output,
+        benchmark=benchmark,
+    )
+    node.tasks.append(loop.create_task(consensus.run()))
+
+    async def analyze() -> None:
+        while True:
+            certificate = await tx_output.get()
+            if on_commit is not None:
+                on_commit(certificate)
+
+    node.tasks.append(loop.create_task(analyze()))
+    return node
+
+
+class WorkerNode:
+    def __init__(self, worker: Worker, store: Store) -> None:
+        self.worker = worker
+        self.store = store
+
+    async def shutdown(self) -> None:
+        await self.worker.shutdown()
+        self.store.close()
+
+
+async def spawn_worker_node(
+    keypair: KeyPair,
+    worker_id: WorkerId,
+    committee: Committee,
+    parameters: Parameters,
+    store_path: Optional[str] = None,
+    benchmark: bool = False,
+) -> WorkerNode:
+    store = Store(store_path)
+    worker = await Worker.spawn(
+        keypair.name, worker_id, committee, parameters, store, benchmark=benchmark
+    )
+    return WorkerNode(worker, store)

@@ -77,7 +77,12 @@ class Proposer:
                     self.payload_size = 0
                     deadline = loop.time() + self.max_header_delay
 
-                timeout = max(0.0, deadline - loop.time())
+                # With no parent quorum the timer is irrelevant (we cannot
+                # propose anyway) — wait purely on the queues instead of
+                # busy-spinning on an already-expired deadline.
+                timeout = (
+                    max(0.0, deadline - loop.time()) if self.last_parents else None
+                )
                 done, _ = await asyncio.wait(
                     {core_get, workers_get},
                     timeout=timeout,

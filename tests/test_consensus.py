@@ -1,0 +1,184 @@
+"""Tusk golden tests (analog of reference consensus_tests.rs): synthetic
+certificate DAGs with no signatures and no network, leader coin pinned to
+authority 0, exact commit sequences asserted."""
+
+import asyncio
+
+from narwhal_tpu.crypto import Digest
+from narwhal_tpu.primary.messages import Certificate, Header, genesis
+from narwhal_tpu.consensus import Consensus, Tusk
+from tests.common import committee, keys
+
+
+def mock_certificate(origin, round_, parents):
+    cert = Certificate(
+        header=Header(
+            author=origin, round=round_, payload={}, parents=set(parents)
+        )
+    )
+    return cert.digest(), cert
+
+
+def make_certificates(start, stop, initial_parents, names):
+    """One certificate per authority for rounds [start, stop]; returns the
+    certificates and the digests to use as next parents."""
+    certificates = []
+    parents = set(initial_parents)
+    next_parents = set()
+    for round_ in range(start, stop + 1):
+        next_parents = set()
+        for name in names:
+            digest, cert = mock_certificate(name, round_, parents)
+            certificates.append(cert)
+            next_parents.add(digest)
+        parents = set(next_parents)
+    return certificates, next_parents
+
+
+def sorted_names():
+    return sorted(kp.name for kp in keys())
+
+
+def genesis_digests(c):
+    return {x.digest() for x in genesis(c)}
+
+
+def feed(tusk, certificates):
+    committed = []
+    for cert in certificates:
+        committed.extend(tusk.process_certificate(cert))
+    return committed
+
+
+def test_commit_one():
+    """4 ideal rounds: the leader of round 2 commits with its round-1
+    parents (reference consensus_tests.rs commit_one)."""
+    c = committee()
+    names = sorted_names()
+    certs, next_parents = make_certificates(1, 4, genesis_digests(c), names)
+    _, trigger = mock_certificate(names[0], 5, next_parents)
+    certs.append(trigger)
+
+    tusk = Tusk(c, gc_depth=50, fixed_coin=True)
+    committed = feed(tusk, certs)
+    assert [x.round for x in committed] == [1, 1, 1, 1, 2]
+
+
+def test_dead_node():
+    """One dead (non-leader) node across 9 rounds: leaders of rounds 2, 4, 6
+    commit; sequence interleaves whole rounds of 3."""
+    c = committee()
+    names = sorted_names()[:3]  # drop the last authority
+    certs, _ = make_certificates(1, 9, genesis_digests(c), names)
+
+    tusk = Tusk(c, gc_depth=50, fixed_coin=True)
+    committed = feed(tusk, certs)
+    rounds = [x.round for x in committed]
+    expected = [(i - 1) // 3 + 1 for i in range(1, 16)] + [6]
+    assert rounds[:16] == expected
+
+
+def test_not_enough_support():
+    """The leader of round 2 lacks f+1 support at first; it commits later,
+    before the leader of round 4 (reference not_enough_support)."""
+    c = committee()
+    names = sorted_names()
+    certs = []
+
+    # Round 1: fully connected among the first 3 nodes.
+    out, parents = make_certificates(1, 1, genesis_digests(c), names[:3])
+    certs.extend(out)
+
+    # Round 2: the only round with 4 certificates; remember the leader's.
+    leader_2_digest, cert = mock_certificate(names[0], 2, parents)
+    certs.append(cert)
+    out, parents = make_certificates(2, 2, parents, names[1:])
+    certs.extend(out)
+
+    # Round 3: only node 0 links to the round-2 leader.
+    next_parents = set()
+    d, cert = mock_certificate(names[1], 3, parents)
+    certs.append(cert)
+    next_parents.add(d)
+    d, cert = mock_certificate(names[2], 3, parents)
+    certs.append(cert)
+    next_parents.add(d)
+    d, cert = mock_certificate(names[0], 3, parents | {leader_2_digest})
+    certs.append(cert)
+    next_parents.add(d)
+    parents = next_parents
+
+    # Rounds 4-6: fully connected among the first 3 nodes.
+    out, parents = make_certificates(4, 6, parents, names[:3])
+    certs.extend(out)
+
+    # Round 7 triggers the commits.
+    _, trigger = mock_certificate(names[0], 7, parents)
+    certs.append(trigger)
+
+    tusk = Tusk(c, gc_depth=50, fixed_coin=True)
+    committed = feed(tusk, certs)
+    rounds = [x.round for x in committed]
+    assert rounds[:11] == [1, 1, 1, 2, 2, 2, 2, 3, 3, 3, 4]
+
+
+def test_missing_leader():
+    """Node 0 (the leader) is absent in rounds 1-2 and reappears from round
+    3: nothing commits until the leader of round 4 (reference
+    missing_leader)."""
+    c = committee()
+    names = sorted_names()
+    certs = []
+    out, parents = make_certificates(1, 2, genesis_digests(c), names[1:])
+    certs.extend(out)
+    out, parents = make_certificates(3, 6, parents, names)
+    certs.extend(out)
+    _, trigger = mock_certificate(names[0], 7, parents)
+    certs.append(trigger)
+
+    tusk = Tusk(c, gc_depth=50, fixed_coin=True)
+    committed = feed(tusk, certs)
+    rounds = [x.round for x in committed]
+    assert rounds[:11] == [1, 1, 1, 2, 2, 2, 3, 3, 3, 3, 4]
+
+
+def test_idempotent_no_double_commit():
+    """Feeding the same certificates again commits nothing new."""
+    c = committee()
+    names = sorted_names()
+    certs, next_parents = make_certificates(1, 4, genesis_digests(c), names)
+    _, trigger = mock_certificate(names[0], 5, next_parents)
+
+    tusk = Tusk(c, gc_depth=50, fixed_coin=True)
+    committed = feed(tusk, certs + [trigger])
+    assert len(committed) == 5
+    committed_again = feed(tusk, certs + [trigger])
+    assert committed_again == []
+
+
+def test_async_consensus_runner():
+    """The async wrapper forwards commits to both outputs in order."""
+
+    async def go():
+        c = committee()
+        names = sorted_names()
+        certs, next_parents = make_certificates(1, 4, genesis_digests(c), names)
+        _, trigger = mock_certificate(names[0], 5, next_parents)
+        certs.append(trigger)
+
+        rx, tx_primary, tx_output = (
+            asyncio.Queue(),
+            asyncio.Queue(),
+            asyncio.Queue(),
+        )
+        consensus = Consensus(c, 50, rx, tx_primary, tx_output, fixed_coin=True)
+        task = asyncio.ensure_future(consensus.run())
+        for cert in certs:
+            await rx.put(cert)
+        out = [await asyncio.wait_for(tx_output.get(), 5) for _ in range(5)]
+        fb = [await asyncio.wait_for(tx_primary.get(), 5) for _ in range(5)]
+        assert [x.round for x in out] == [1, 1, 1, 1, 2]
+        assert [x.digest() for x in fb] == [x.digest() for x in out]
+        task.cancel()
+
+    asyncio.run(asyncio.wait_for(go(), 15))

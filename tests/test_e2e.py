@@ -1,0 +1,91 @@
+"""Full-system end-to-end test: a 4-node committee (primary + worker +
+consensus each) in one process over loopback TCP; client transactions must
+come out as committed certificates carrying their batch digest at every node
+(the reference's `fab local` path as a test, SURVEY.md §7)."""
+
+import asyncio
+
+import pytest
+
+from narwhal_tpu.config import Parameters
+from narwhal_tpu.network.framing import parse_address, write_frame
+from narwhal_tpu.node import spawn_primary_node, spawn_worker_node
+from tests.common import committee, keys
+
+
+@pytest.fixture
+def run():
+    def _run(coro):
+        return asyncio.run(asyncio.wait_for(coro, 60))
+
+    return _run
+
+
+def test_four_node_commit(run):
+    async def go():
+        c = committee(base_port=14000)
+        params = Parameters(
+            header_size=32,  # propose as soon as one digest arrives
+            max_header_delay=100,
+            batch_size=400,
+            max_batch_delay=100,
+        )
+        commits = {i: [] for i in range(4)}
+        nodes = []
+        for i, kp in enumerate(keys()):
+            nodes.append(
+                await spawn_primary_node(
+                    kp,
+                    c,
+                    params,
+                    on_commit=lambda cert, i=i: commits[i].append(cert),
+                )
+            )
+            nodes.append(await spawn_worker_node(kp, 0, c, params))
+
+        # Push transactions into node 0's worker.
+        host, port = parse_address(c.worker(keys()[0].name, 0).transactions)
+        _, w = await asyncio.open_connection(host, port)
+        txs = [bytes([1]) + i.to_bytes(8, "little") + bytes(91) for i in range(8)]
+        for tx in txs:
+            await write_frame(w, tx)
+
+        # batch_size=400 seals every 4 of our 100 B txs into one batch; wait
+        # until BOTH batches commit at every node.
+        from narwhal_tpu.crypto import sha512_digest
+        from narwhal_tpu.messages import encode_batch
+
+        expected = {
+            sha512_digest(encode_batch(txs[:4])),
+            sha512_digest(encode_batch(txs[4:])),
+        }
+
+        def payload_committed(certs):
+            return expected <= {
+                d for cert in certs for d in cert.header.payload
+            }
+
+        for _ in range(600):
+            if all(payload_committed(v) for v in commits.values()):
+                break
+            await asyncio.sleep(0.1)
+        else:
+            raise AssertionError(
+                f"payload never committed: {[len(v) for v in commits.values()]}"
+            )
+
+        # All nodes commit the same certificates in the same order.
+        seqs = [
+            [cert.digest() for cert in commits[i]] for i in range(4)
+        ]
+        common = min(len(s) for s in seqs)
+        assert common > 0
+        for i in range(1, 4):
+            assert seqs[i][:common] == seqs[0][:common]
+
+
+        w.close()
+        for node in nodes:
+            await node.shutdown()
+
+    run(go())
