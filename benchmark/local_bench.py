@@ -106,7 +106,6 @@ def run_bench(
                 sys.executable,
                 "-m",
                 "narwhal_tpu.node",
-                "-v",
                 "run",
                 "--keys",
                 f"{workdir}/node-{i}.json",
@@ -129,7 +128,6 @@ def run_bench(
                     sys.executable,
                     "-m",
                     "narwhal_tpu.node",
-                    "-v",
                     "run",
                     "--keys",
                     f"{workdir}/node-{i}.json",

@@ -209,8 +209,15 @@ class Consensus:
         tx_output: asyncio.Queue,
         benchmark: bool = False,
         fixed_coin: bool = False,
+        use_kernel: bool = False,
     ) -> None:
-        self.tusk = Tusk(committee, gc_depth, fixed_coin=fixed_coin)
+        if use_kernel:
+            # Deferred: the pure-CPU node path must not pay the JAX import.
+            from ..ops.reachability import KernelTusk
+
+            self.tusk = KernelTusk(committee, gc_depth, fixed_coin=fixed_coin)
+        else:
+            self.tusk = Tusk(committee, gc_depth, fixed_coin=fixed_coin)
         self.rx_primary = rx_primary
         self.tx_primary = tx_primary
         self.tx_output = tx_output

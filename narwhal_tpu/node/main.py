@@ -48,6 +48,18 @@ def main(argv=None) -> int:
     run.add_argument("--parameters")
     run.add_argument("--store", required=True)
     run.add_argument("--benchmark", action="store_true", default=False)
+    run.add_argument(
+        "--consensus-kernel",
+        action="store_true",
+        default=False,
+        help="Run Tusk's order_leaders on the JAX device kernel",
+    )
+    run.add_argument(
+        "--crypto-backend",
+        choices=["cpu", "tpu"],
+        default=None,
+        help="Signature verification backend (default: cpu)",
+    )
     runsub = run.add_subparsers(dest="role", required=True)
     runsub.add_parser("primary", help="Run a single primary")
     wrk = runsub.add_parser("worker", help="Run a single worker")
@@ -66,6 +78,10 @@ def main(argv=None) -> int:
         Parameters.load(args.parameters) if args.parameters else Parameters()
     )
     parameters.log(logging.getLogger("narwhal.node"))
+    if args.crypto_backend:
+        from ..crypto import backend as crypto_backend
+
+        crypto_backend.set_backend(args.crypto_backend)
 
     async def run_node() -> None:
         if args.role == "primary":
@@ -75,6 +91,7 @@ def main(argv=None) -> int:
                 parameters,
                 store_path=f"{args.store}/store.log",
                 benchmark=args.benchmark,
+                use_kernel=args.consensus_kernel,
             )
         else:
             node = await spawn_worker_node(

@@ -48,6 +48,7 @@ async def spawn_primary_node(
     store_path: Optional[str] = None,
     benchmark: bool = False,
     on_commit: Optional[Callable] = None,
+    use_kernel: bool = False,
 ) -> PrimaryNode:
     """Primary + Consensus pair with the GC feedback loop.  `on_commit`
     (sync callable) is the application layer — the reference's `analyze()`
@@ -76,6 +77,7 @@ async def spawn_primary_node(
         tx_primary=tx_feedback,
         tx_output=tx_output,
         benchmark=benchmark,
+        use_kernel=use_kernel,
     )
     node.tasks.append(loop.create_task(consensus.run()))
 

@@ -1,0 +1,12 @@
+"""TPU-resident kernels (JAX/XLA) behind the framework's CPU seams.
+
+- reachability: the Tusk commit rule's graph traversals (linked()/order_dag
+  frontier walks, reference consensus/src/lib.rs:247-303) as one jitted
+  boolean matrix scan over the (gc_depth x committee) certificate window.
+- ed25519: batched on-device signature verification (reference
+  crypto/src/lib.rs:206-219 verify_batch) — field/point arithmetic from
+  32-bit lanes, vmapped over the batch.
+
+Import is deferred by callers (crypto.backend, consensus) so the pure-CPU
+protocol path never pays the JAX import cost.
+"""

@@ -1,0 +1,256 @@
+"""Tusk's DAG traversals as one jitted boolean-matrix scan on device.
+
+The reference commit rule (consensus/src/lib.rs:224-303) does two kinds of
+graph walk per candidate leader:
+
+- ``order_leaders`` calls ``linked()`` once per earlier leader — each call a
+  round-by-round BFS over the whole certificate window (lib.rs:247-259);
+- ``order_dag`` flattens the causal history of every newly committed leader
+  (lib.rs:263-303).
+
+Both are frontier propagations through the round-structured DAG.  Here the
+window is a dense tensor — ``exists[w, n]`` (certificate present at slot w,
+authority n) and ``parent[w, n, m]`` (cert (w, n) references cert (w-1, m)) —
+and a single ``lax.scan`` down the window computes the ENTIRE leader chain:
+the frontier is a length-N boolean vector, each step is a vector–matrix
+product (int32 matmul → MXU), and when the frontier reaches the leader of an
+even round the scan records a committed leader and resets the frontier to
+that leader alone (exactly the ``leader = prev_leader`` rebinding in
+``order_leaders``).  The same scan emits the per-slot reach masks used to
+bound the host-side emission DFS.
+
+Slots are fixed-size (static shapes for XLA): slot w holds round
+``base_round + w``.  The committee axis N is padded to the committee size;
+the window W to a static power-of-two ≥ gc_depth.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@partial(jax.jit, static_argnames=("window",))
+def leader_chain_scan(
+    parent: jax.Array,  # bool[W, N, N]
+    exists: jax.Array,  # bool[W, N]
+    leader_onehot: jax.Array,  # bool[W, N] — leader identity of slot w's round
+    is_leader_slot: jax.Array,  # bool[W] — even round in (last_committed, anchor)
+    anchor_slot: jax.Array,  # i32 scalar
+    anchor_onehot: jax.Array,  # bool[N]
+    window: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """One descending scan = the whole ``order_leaders`` chain.
+
+    Returns ``(committed[W], reach[W, N])``: committed[w] marks the round at
+    slot w as a linked (to-commit) leader round; reach[w] is the certificate
+    frontier at slot w (the causal cone of the current chain head), which
+    upper-bounds the certificates ``order_dag`` can emit from that slot.
+    """
+    W = window
+
+    def step(frontier, xs):
+        w, parent_up, exists_w, leader_w, is_lead_w = xs
+        # Certificates at slot w referenced by the frontier one round up.
+        # int32 matvec: lands on the MXU for large committees, exact for bool.
+        hit = (
+            jnp.matmul(
+                frontier.astype(jnp.int32),
+                parent_up.astype(jnp.int32),
+                preferred_element_type=jnp.int32,
+            )
+            > 0
+        )
+        g = hit & exists_w
+        g = jnp.where(w == anchor_slot, anchor_onehot, g)
+        lead_here = is_lead_w & (w < anchor_slot) & jnp.any(g & leader_w)
+        # Frontier reset: the chain head becomes this leader (order_leaders'
+        # ``leader = prev_leader``), so deeper reachability is from it alone.
+        new_frontier = jnp.where(lead_here, g & leader_w, g)
+        return new_frontier, (lead_here, g)
+
+    slots = jnp.arange(W - 1, -1, -1, dtype=jnp.int32)
+    # Step at slot w consumes parent[w+1] (edges slot w+1 → slot w).
+    parent_up = jnp.concatenate(
+        [parent[1:], jnp.zeros((1,) + parent.shape[1:], parent.dtype)], axis=0
+    )
+    xs = (
+        slots,
+        parent_up[slots],
+        exists[slots],
+        leader_onehot[slots],
+        is_leader_slot[slots],
+    )
+    _, (committed_rev, reach_rev) = lax.scan(
+        step, jnp.zeros(exists.shape[1], dtype=bool), xs
+    )
+    return committed_rev[::-1], reach_rev[::-1]
+
+
+@partial(jax.jit, static_argnames=("window",))
+def causal_mask_scan(
+    parent: jax.Array,  # bool[W, N, N]
+    exists: jax.Array,  # bool[W, N]
+    start_slot: jax.Array,  # i32 scalar
+    start_onehot: jax.Array,  # bool[N]
+    window: int,
+) -> jax.Array:
+    """Full causal cone of one certificate: bool[W, N] mask of every
+    certificate reachable from (start_slot, start_onehot) through parent
+    links — the set ``order_dag`` flattens (lib.rs:263-303).  Unlike
+    :func:`leader_chain_scan` the frontier accumulates (no resets)."""
+    W = window
+
+    def step(frontier, xs):
+        w, parent_up, exists_w = xs
+        hit = (
+            jnp.matmul(
+                frontier.astype(jnp.int32),
+                parent_up.astype(jnp.int32),
+                preferred_element_type=jnp.int32,
+            )
+            > 0
+        )
+        g = hit & exists_w
+        g = g | jnp.where(w == start_slot, start_onehot, False)
+        return g, g
+
+    slots = jnp.arange(W - 1, -1, -1, dtype=jnp.int32)
+    parent_up = jnp.concatenate(
+        [parent[1:], jnp.zeros((1,) + parent.shape[1:], parent.dtype)], axis=0
+    )
+    xs = (slots, parent_up[slots], exists[slots])
+    _, mask_rev = lax.scan(step, jnp.zeros(exists.shape[1], dtype=bool), xs)
+    return mask_rev[::-1]
+
+
+@partial(jax.jit, static_argnames=("window",))
+def support_stake(
+    parent: jax.Array,  # bool[W, N, N]
+    exists: jax.Array,  # bool[W, N]
+    stake: jax.Array,  # i32[N]
+    leader_slot: jax.Array,  # i32 scalar
+    leader_onehot: jax.Array,  # bool[N]
+    window: int,
+) -> jax.Array:
+    """Stake of slot leader_slot+1 certificates referencing the leader —
+    the f+1 support gate (lib.rs:141-157)."""
+    child = parent[leader_slot + 1]  # bool[N, N]: child cert → its parents
+    votes = jnp.any(child & leader_onehot[None, :], axis=1)
+    votes = votes & exists[leader_slot + 1]
+    return jnp.sum(jnp.where(votes, stake, 0))
+
+
+class DagWindow:
+    """Dense tensor view of a Tusk DAG window, built from the live dict DAG.
+
+    Host-side glue: maps (round, authority) → (slot, index), resolves parent
+    digests, and hands fixed-shape arrays to the jitted scans.  Rebuilt per
+    commit attempt — O(window · N · parents) dict work, replacing up to
+    window/2 independent BFS passes of the same cost each.
+    """
+
+    def __init__(
+        self,
+        dag,  # Dag: round → {authority → (digest, certificate)}
+        names: List,  # sorted authority public keys
+        base_round: int,
+        window: int,
+    ) -> None:
+        self.names = names
+        self.index = {name: i for i, name in enumerate(names)}
+        self.base_round = base_round
+        self.window = window
+        n = len(names)
+        self.exists = np.zeros((window, n), dtype=bool)
+        self.parent = np.zeros((window, n, n), dtype=bool)
+        # digest → (slot, authority index) for every cert in the window
+        digest_pos: Dict[bytes, Tuple[int, int]] = {}
+        for r, certs in dag.items():
+            w = r - base_round
+            if 0 <= w < window:
+                for name, (digest, _) in certs.items():
+                    i = self.index[name]
+                    self.exists[w, i] = True
+                    digest_pos[bytes(digest)] = (w, i)
+        for r, certs in dag.items():
+            w = r - base_round
+            if not (1 <= w < window):
+                continue
+            for name, (_, cert) in certs.items():
+                i = self.index[name]
+                for pd in cert.header.parents:
+                    pos = digest_pos.get(bytes(pd))
+                    if pos is not None and pos[0] == w - 1:
+                        self.parent[w, i, pos[1]] = True
+
+    def slot(self, round_: int) -> int:
+        return round_ - self.base_round
+
+    def onehot(self, name) -> np.ndarray:
+        v = np.zeros(len(self.names), dtype=bool)
+        v[self.index[name]] = True
+        return v
+
+
+from ..consensus.tusk import Tusk
+
+
+class KernelTusk(Tusk):
+    """Tusk with ``order_leaders`` executed on device: same decisions as the
+    golden Python implementation (consensus/tusk.py, validated
+    certificate-for-certificate by tests/test_reachability.py), with the
+    window traversals collapsed into one :func:`leader_chain_scan`.  The
+    emission DFS (``order_dag``) stays host-side — it is O(output) and must
+    produce the exact reference DFS tie-order."""
+
+    def _leader_name(self, round_: int):
+        coin = 0 if self.fixed_coin else round_
+        return self._sorted_keys[coin % len(self._sorted_keys)]
+
+    def order_leaders(self, leader) -> List:
+        state = self.state
+        names = self._sorted_keys
+        n = len(names)
+        base = max(0, state.last_committed_round)
+        span = leader.round - base + 1
+        window = 8
+        while window < span:
+            window <<= 1
+        win = DagWindow(state.dag, names, base, window)
+
+        leader_onehot = np.zeros((window, n), dtype=bool)
+        is_leader_slot = np.zeros(window, dtype=bool)
+        for w in range(window):
+            r = base + w
+            if r % 2 == 0 and state.last_committed_round < r < leader.round:
+                name = self._leader_name(r)
+                if state.dag.get(r, {}).get(name) is not None:
+                    leader_onehot[w, win.index[name]] = True
+                    is_leader_slot[w] = True
+
+        committed, _reach = leader_chain_scan(
+            jnp.asarray(win.parent),
+            jnp.asarray(win.exists),
+            jnp.asarray(leader_onehot),
+            jnp.asarray(is_leader_slot),
+            jnp.int32(win.slot(leader.round)),
+            jnp.asarray(win.onehot(leader.origin)),
+            window,
+        )
+        committed = np.asarray(committed)
+
+        # Newest-first chain, exactly as the golden order_leaders returns it.
+        to_commit = [leader]
+        for w in range(window - 1, -1, -1):
+            if committed[w]:
+                r = base + w
+                _, cert = state.dag[r][self._leader_name(r)]
+                to_commit.append(cert)
+        return to_commit
