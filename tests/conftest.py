@@ -1,12 +1,27 @@
 """Test harness config: force JAX onto a virtual 8-device CPU mesh so
 sharding/pjit tests run without TPU hardware (the driver separately
-dry-runs the multi-chip path; see __graft_entry__.py)."""
+dry-runs the multi-chip path; see __graft_entry__.py).
+
+The host environment may pin JAX to a real accelerator two ways: the
+JAX_PLATFORMS env var, and an interpreter-startup plugin (sitecustomize)
+that registers a backend and overrides ``jax_platforms`` via jax.config.
+Both are overridden here — env vars first (read when the CPU client is
+created), then the config knob, which wins over anything a startup hook
+set."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = [
+    f for f in os.environ.get("XLA_FLAGS", "").split()
+    if "xla_force_host_platform_device_count" not in f
+]
+_flags.append("--xla_force_host_platform_device_count=8")
+os.environ["XLA_FLAGS"] = " ".join(_flags)
+
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:  # tests that don't need jax still run
+    pass
