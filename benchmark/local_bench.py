@@ -52,6 +52,30 @@ def build_committee(keypairs, base_port, workers):
     return Committee(auths)
 
 
+def kill_stale_nodes() -> None:
+    """Kill node/client processes left over from a previous run of THIS
+    checkout — the reference harness does the same by killing its old tmux
+    testbed (reference benchmark/benchmark/local.py:26-29).  Stale nodes
+    squat on ports and burn CPU, silently corrupting the next measurement.
+    Scoped by process cwd == this repo, so concurrent harnesses in other
+    checkouts are left alone."""
+    me = os.getpid()
+    for pid_s in os.listdir("/proc"):
+        if not pid_s.isdigit() or int(pid_s) == me:
+            continue
+        try:
+            with open(f"/proc/{pid_s}/cmdline", "rb") as f:
+                cmd = f.read().replace(b"\x00", b" ")
+            if (b"-m narwhal_tpu.node" not in cmd
+                    and b"narwhal_tpu.node.benchmark_client" not in cmd):
+                continue
+            if os.readlink(f"/proc/{pid_s}/cwd") != REPO:
+                continue
+            os.kill(int(pid_s), signal.SIGKILL)
+        except OSError:
+            continue
+
+
 def run_bench(
     nodes: int = 4,
     workers: int = 1,
@@ -68,6 +92,7 @@ def run_bench(
     keep_logs: bool = False,
     quiet: bool = False,
 ):
+    kill_stale_nodes()
     workdir = workdir or os.path.join(REPO, ".bench")
     shutil.rmtree(workdir, ignore_errors=True)
     os.makedirs(workdir, exist_ok=True)
@@ -178,13 +203,19 @@ def run_bench(
         print(f"Running benchmark ({duration} s)...", file=sys.stderr)
     time.sleep(duration)
 
+    # SIGTERM first (lets NARWHAL_PROFILE dumps flush), then SIGKILL.
     for p, f in procs:
         try:
-            p.send_signal(signal.SIGKILL)
+            p.send_signal(signal.SIGTERM)
         except ProcessLookupError:
             pass
+    deadline = time.time() + 3
     for p, f in procs:
-        p.wait()
+        try:
+            p.wait(timeout=max(0.1, deadline - time.time()))
+        except subprocess.TimeoutExpired:
+            p.kill()
+            p.wait()
         f.close()
 
     read = lambda paths: [open(p).read() for p in paths]  # noqa: E731

@@ -12,7 +12,7 @@ routes `verify_batch` through the JAX batched-verification kernel in
 loop per SURVEY.md §3.3).
 """
 
-from .digest import Digest, sha512_digest
+from .digest import Digest, digest32
 from .keys import KeyPair, PublicKey, SecretKey, Signature
 from .service import SignatureService
 from .backend import (
@@ -25,7 +25,7 @@ from .backend import (
 
 __all__ = [
     "Digest",
-    "sha512_digest",
+    "digest32",
     "KeyPair",
     "PublicKey",
     "SecretKey",

@@ -15,6 +15,23 @@ _LEN = struct.Struct("<I")
 # that still rejects garbage/hostile length prefixes.
 MAX_FRAME = 32 * 1024 * 1024
 
+# Stream buffer limit for every reader/writer that can carry batch frames.
+# asyncio's default is 64 KiB, which turns each ~500 kB frame into ~8
+# pause/resume event-loop round trips; when many node processes share few
+# cores each round trip costs a scheduling quantum and the ACK RTT — and
+# with it quorum throughput — collapses.  An 8 MiB window moves whole
+# batches per wakeup.
+STREAM_LIMIT = 8 * 1024 * 1024
+
+
+def tune_writer(writer: "asyncio.StreamWriter") -> None:
+    """Raise the transport's write high-water mark so large frames are
+    buffered in one go instead of trickling out 64 KiB per drain cycle."""
+    try:
+        writer.transport.set_write_buffer_limits(high=STREAM_LIMIT)
+    except (AttributeError, RuntimeError):  # non-socket transports (tests)
+        pass
+
 
 class FrameError(Exception):
     pass

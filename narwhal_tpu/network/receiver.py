@@ -9,7 +9,14 @@ import asyncio
 import logging
 from typing import Protocol
 
-from .framing import FrameError, parse_address, read_frame, write_frame
+from .framing import (
+    STREAM_LIMIT,
+    FrameError,
+    parse_address,
+    read_frame,
+    tune_writer,
+    write_frame,
+)
 
 log = logging.getLogger(__name__)
 
@@ -44,7 +51,9 @@ class Receiver:
     async def spawn(cls, address: str, handler: MessageHandler) -> "Receiver":
         self = cls(address, handler)
         host, port = parse_address(address)
-        self._server = await asyncio.start_server(self._on_connection, host, port)
+        self._server = await asyncio.start_server(
+            self._on_connection, host, port, limit=STREAM_LIMIT
+        )
         log.debug("Listening on %s", address)
         return self
 
@@ -73,6 +82,7 @@ class Receiver:
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
         peer = writer.get_extra_info("peername")
+        tune_writer(writer)
         w = Writer(writer)
         try:
             while True:

@@ -16,7 +16,15 @@ import collections
 import logging
 from typing import Deque, Dict, List, Sequence, Tuple
 
-from .framing import MAX_FRAME, parse_address, read_frame, write_frame, sample_peers
+from .framing import (
+    MAX_FRAME,
+    STREAM_LIMIT,
+    parse_address,
+    read_frame,
+    sample_peers,
+    tune_writer,
+    write_frame,
+)
 
 log = logging.getLogger(__name__)
 
@@ -70,7 +78,10 @@ class _Connection:
         try:
             while True:
                 try:
-                    reader, writer = await asyncio.open_connection(host, port)
+                    reader, writer = await asyncio.open_connection(
+                        host, port, limit=STREAM_LIMIT
+                    )
+                    tune_writer(writer)
                 except OSError as e:
                     log.debug("ReliableSender: cannot reach %s: %s", self.address, e)
                     await asyncio.sleep(delay)

@@ -11,7 +11,14 @@ import asyncio
 import logging
 from typing import Dict, Sequence
 
-from .framing import parse_address, read_frame, sample_peers, write_frame
+from .framing import (
+    STREAM_LIMIT,
+    parse_address,
+    read_frame,
+    sample_peers,
+    tune_writer,
+    write_frame,
+)
 
 log = logging.getLogger(__name__)
 
@@ -29,7 +36,10 @@ class _Peer:
         while True:
             data = await self.queue.get()
             try:
-                reader, writer = await asyncio.open_connection(host, port)
+                reader, writer = await asyncio.open_connection(
+                    host, port, limit=STREAM_LIMIT
+                )
+                tune_writer(writer)
             except OSError as e:
                 log.debug("SimpleSender: cannot reach %s: %s", self.address, e)
                 continue  # drop this message; try fresh on the next one

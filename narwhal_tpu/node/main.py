@@ -11,6 +11,8 @@ from __future__ import annotations
 import argparse
 import asyncio
 import logging
+import os
+import signal
 import sys
 
 from ..config import Committee, Parameters, export_keypair, load_keypair
@@ -84,6 +86,11 @@ def main(argv=None) -> int:
         crypto_backend.set_backend(args.crypto_backend)
 
     async def run_node() -> None:
+        # Graceful SIGTERM: set the stop event from the loop (raising out of
+        # a sync signal handler would interrupt arbitrary tasks and litter
+        # the logs with spurious exceptions the bench parser flags).
+        stop = asyncio.Event()
+        asyncio.get_running_loop().add_signal_handler(signal.SIGTERM, stop.set)
         if args.role == "primary":
             node = await spawn_primary_node(
                 keypair,
@@ -103,14 +110,32 @@ def main(argv=None) -> int:
                 benchmark=args.benchmark,
             )
         try:
-            await asyncio.Event().wait()  # run forever
+            await stop.wait()  # run until SIGTERM/SIGINT
         finally:
             await node.shutdown()
+
+    # NARWHAL_PROFILE=<dir>: cProfile the whole node, dumping stats on
+    # SIGTERM (the harness sends SIGTERM before SIGKILL for this reason).
+    profile_dir = os.environ.get("NARWHAL_PROFILE")
+    profiler = None
+    if profile_dir:
+        import cProfile
+
+        profiler = cProfile.Profile()
+        profiler.enable()
 
     try:
         asyncio.run(run_node())
     except KeyboardInterrupt:
         pass
+    finally:
+        if profiler is not None:
+            profiler.disable()
+            os.makedirs(profile_dir, exist_ok=True)
+            role = args.role if args.command == "run" else "node"
+            profiler.dump_stats(
+                os.path.join(profile_dir, f"{role}-{os.getpid()}.prof")
+            )
     return 0
 
 

@@ -20,7 +20,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Set, Tuple
 
 from ..config import Committee, WorkerId
-from ..crypto import Digest, PublicKey, Signature, sha512_digest, verify, verify_batch
+from ..crypto import Digest, PublicKey, Signature, digest32, verify, verify_batch
 from ..messages import Round
 from ..utils.serde import Reader, Writer
 from .errors import (
@@ -59,7 +59,7 @@ class Header:
             w.u32(self.payload[digest])
         for parent in sorted(self.parents):
             w.raw(parent)
-        return sha512_digest(w.finish())
+        return digest32(w.finish())
 
     def verify(self, committee: Committee) -> None:
         """Reference messages.rs:48-67."""
@@ -128,7 +128,7 @@ class Vote:
         w.raw(self.id)
         w.u64(self.round)
         w.raw(self.origin)
-        return sha512_digest(w.finish())
+        return digest32(w.finish())
 
     def verify(self, committee: Committee) -> None:
         if committee.stake(self.author) <= 0:
@@ -178,7 +178,7 @@ class Certificate:
         w.raw(self.header.id)
         w.u64(self.round)
         w.raw(self.origin)
-        return sha512_digest(w.finish())
+        return digest32(w.finish())
 
     def verify(self, committee: Committee) -> None:
         """Quorum + batched signature check (reference messages.rs:189-215).

@@ -10,7 +10,10 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 from typing import List, Tuple
+
+_TRACE = bool(os.environ.get("NARWHAL_TRACE"))
 
 from ..config import Committee, WorkerId
 from ..crypto import Digest, PublicKey, SignatureService
@@ -99,6 +102,8 @@ class Proposer:
                 if workers_get in done:
                     digest, worker_id = workers_get.result()
                     workers_get = loop.create_task(self.rx_workers.get())
+                    if _TRACE:
+                        log.info("TRACE payload arrived %r", digest)
                     self.payload_size += len(digest)
                     self.digests.append((digest, worker_id))
         finally:

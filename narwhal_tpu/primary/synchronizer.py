@@ -10,12 +10,17 @@ loops the message back to the Core when the dependency lands in the store.
 from __future__ import annotations
 
 import asyncio
+import logging
+import os
 from typing import Dict, List, Optional
 
 from ..config import Committee
 from ..crypto import Digest, PublicKey
 from ..store import Store
 from .messages import Certificate, Header, genesis
+
+log = logging.getLogger("narwhal.primary")
+_TRACE = bool(os.environ.get("NARWHAL_TRACE"))
 
 
 def payload_key(digest: Digest, worker_id: int) -> bytes:
@@ -50,6 +55,9 @@ class Synchronizer:
                 missing[digest] = worker_id
         if not missing:
             return False
+        if _TRACE:
+            log.info("TRACE suspend header %r: %d payload missing",
+                     header.id, len(missing))
         await self.tx_header_waiter.put(("sync_batches", missing, header))
         return True
 
@@ -69,6 +77,9 @@ class Synchronizer:
                 parents.append(Certificate.deserialize(raw))
         if not missing:
             return parents
+        if _TRACE:
+            log.info("TRACE suspend header %r: %d parents missing",
+                     header.id, len(missing))
         await self.tx_header_waiter.put(("sync_parents", missing, header))
         return []
 

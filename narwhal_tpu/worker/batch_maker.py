@@ -1,4 +1,4 @@
-"""BatchMaker: accumulate client transactions into sealed batches.
+"""BatchMaker: the worker's transaction ingestion plane.
 
 Reference worker/src/batch_maker.rs (157 LoC): gather raw transactions until
 `batch_size` bytes or `max_batch_delay` ms (71-98), then seal — serialize,
@@ -6,20 +6,62 @@ reliable-broadcast the batch to the same-id workers of every other authority,
 and hand the serialized batch plus its ACK futures to the QuorumWaiter
 (102-156).  Under benchmark mode, log the sample-tx ids and the batch size so
 the log parser can compute TPS and latency (103-141).
+
+TPU-host design difference from the reference: the per-transaction loop
+(frame split, byte counting, sample scan, batch serialization) runs in the
+native data plane (native/dataplane.c) on raw socket buffers — this class
+binds the client transaction socket itself (replacing the generic Receiver +
+per-tx queue of the reference architecture) and observes only *sealed
+batches*, tens per second.  Python cost is therefore per-batch, not per-tx —
+essential on small host cores where the whole committee shares the CPU.
+
+Backpressure: when the downstream queue fills, reading is paused on every
+client transport (TCP flow control pushes back to the client), mirroring the
+bounded-channel backpressure of the reference (worker.rs:26).
 """
 
 from __future__ import annotations
 
 import asyncio
 import logging
-from typing import List, Tuple
+from typing import List, Optional, Tuple
 
+from .. import native
 from ..config import Committee, WorkerId
-from ..crypto import PublicKey, sha512_digest
-from ..messages import Transaction, encode_batch
+from ..crypto import PublicKey, digest32
 from ..network import ReliableSender
+from ..network.framing import parse_address
 
 log = logging.getLogger("narwhal.worker")
+
+
+class _TxProtocol(asyncio.Protocol):
+    """One inbound client connection: feeds raw chunks to the shared
+    batcher through a per-connection framer (partial frames are
+    per-stream state)."""
+
+    __slots__ = ("maker", "framer", "transport")
+
+    def __init__(self, maker: "BatchMaker") -> None:
+        self.maker = maker
+        self.framer = native.make_framer(maker.batcher)
+        self.transport = None
+
+    def connection_made(self, transport) -> None:
+        self.transport = transport
+        self.maker._protocols.add(self)
+        if self.maker._paused:
+            transport.pause_reading()
+
+    def data_received(self, data: bytes) -> None:
+        try:
+            self.maker._on_tx_data(self.framer, data)
+        except ValueError as e:
+            log.warning("Dropping tx connection (malformed stream): %s", e)
+            self.transport.close()
+
+    def connection_lost(self, exc) -> None:
+        self.maker._protocols.discard(self)
 
 
 class BatchMaker:
@@ -30,7 +72,7 @@ class BatchMaker:
         committee: Committee,
         batch_size: int,
         max_batch_delay_ms: int,
-        tx_queue: asyncio.Queue,
+        address: str,  # client transaction socket to bind
         out_queue: asyncio.Queue,  # → QuorumWaiter: (serialized, [(stake, fut)])
         benchmark: bool = False,
     ) -> None:
@@ -39,66 +81,127 @@ class BatchMaker:
         self.committee = committee
         self.batch_size = batch_size
         self.max_batch_delay = max_batch_delay_ms / 1000.0
-        self.tx_queue = tx_queue
+        self.address = address
         self.out_queue = out_queue
         self.benchmark = benchmark
         self.sender = ReliableSender()
-        self._batch: List[Transaction] = []
-        self._bytes = 0
+        self.batcher = native.make_batcher(batch_size)
+        # Same-id workers at every other authority, resolved once.
+        self._peers: List[Tuple[int, str]] = [
+            (committee.stake(peer_name), addrs.worker_to_worker)
+            for peer_name, addrs in committee.others_workers(name, worker_id)
+        ]
+        self._protocols: set = set()
+        self._paused = False
+        self._overflow: List = []
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._deadline: Optional[float] = None
+        self._dirty = asyncio.Event()
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self.started = asyncio.Event()  # set once the tx socket is bound
+        self.boot_error: Optional[BaseException] = None  # bind failure
+
+    @property
+    def port(self) -> int:
+        """Actual bound port (useful when spawned with port 0)."""
+        assert self._server is not None
+        return self._server.sockets[0].getsockname()[1]
 
     async def run(self) -> None:
-        # The seal deadline is fixed when the first tx of a batch arrives —
-        # NOT restarted per tx — so a steady trickle still seals every
-        # max_batch_delay (reference batch_maker.rs:71-98 uses an interval
-        # timer for the same reason).
-        loop = asyncio.get_running_loop()
-        deadline = None
-        while True:
-            if deadline is None:
-                tx = await self.tx_queue.get()
-                deadline = loop.time() + self.max_batch_delay
-            else:
-                remaining = deadline - loop.time()
-                if remaining <= 0:
-                    await self._seal()
-                    deadline = None
+        self._loop = asyncio.get_running_loop()
+        host, port = parse_address(self.address)
+        try:
+            self._server = await self._loop.create_server(
+                lambda: _TxProtocol(self), host, port
+            )
+        except BaseException as e:
+            # Surface bind failures to Worker.spawn (which waits on
+            # `started`) instead of dying silently in this task.
+            self.boot_error = e
+            self.started.set()
+            raise
+        self.started.set()
+        try:
+            # The seal deadline is fixed when the first tx of a batch
+            # arrives — NOT restarted per tx — so a steady trickle still
+            # seals every max_batch_delay (reference batch_maker.rs:71-98
+            # uses an interval timer for the same reason).
+            while True:
+                await self._dirty.wait()
+                deadline = self._deadline
+                if deadline is None:  # sealed by size meanwhile
+                    self._dirty.clear()
                     continue
-                try:
-                    tx = await asyncio.wait_for(self.tx_queue.get(), remaining)
-                except asyncio.TimeoutError:
-                    await self._seal()
-                    deadline = None
-                    continue
-            self._batch.append(tx)
-            self._bytes += len(tx)
-            if self._bytes >= self.batch_size:
-                await self._seal()
-                deadline = None
+                remaining = deadline - self._loop.time()
+                if remaining > 0:
+                    await asyncio.sleep(remaining)
+                    continue  # re-check: a size-seal may have intervened
+                self._seal()
+        finally:
+            self._server.close()
+            for p in list(self._protocols):
+                if p.transport is not None:
+                    p.transport.close()
 
-    async def _seal(self) -> None:
-        batch, self._batch = self._batch, []
-        size, self._bytes = self._bytes, 0
-        serialized = encode_batch(batch)
+    # -- hot path (called from data_received; must not await) ---------------
 
+    def _on_tx_data(self, framer, data: bytes) -> None:
+        batcher = self.batcher
+        more = framer.feed(batcher, data)
+        while more:
+            self._seal()
+            more = framer.feed(batcher, b"")  # drain retained remainder
+        if batcher.tx_count > 0 and self._deadline is None:
+            # First tx of a new batch (fresh stream or post-seal remainder):
+            # fix the seal deadline now, not per tx.
+            self._deadline = self._loop.time() + self.max_batch_delay
+            self._dirty.set()
+
+    def _seal(self) -> None:
+        self._deadline = None
+        self._dirty.clear()
+        sealed = self.batcher.seal()
+        if sealed is None:
+            return
+
+        # The digest is computed exactly once per own batch, here, and flows
+        # with the message through QuorumWaiter → Processor (the reference
+        # re-hashes in the processor, processor.rs:35 — at ~500 kB per batch
+        # the duplicate hash is worth eliminating on shared-core hosts).
+        digest = digest32(sealed.message)
         if self.benchmark:
-            digest = sha512_digest(serialized)
-            # Sample transactions carry byte0 == 0 and a u64 counter; the log
-            # parser joins these lines with the client's send log to measure
-            # end-to-end latency (reference batch_maker.rs:103-141).
-            for tx in batch:
-                if tx and tx[0] == 0 and len(tx) >= 9:
-                    sample_id = int.from_bytes(tx[1:9], "little")
-                    log.info("Batch %r contains sample tx %d", digest, sample_id)
-            log.info("Batch %r contains %d B", digest, size)
+            # Sample transactions carry byte0 == 0 and a u64 counter; the
+            # log parser joins these lines with the client's send log to
+            # measure end-to-end latency (reference batch_maker.rs:103-141).
+            for sample_id in sealed.samples:
+                log.info("Batch %r contains sample tx %d", digest, sample_id)
+            log.info("Batch %r contains %d B", digest, sealed.tx_bytes)
 
         # Reliable-broadcast to our counterpart workers at every other
         # authority; the ACK futures feed the quorum count.
-        peers: List[Tuple[PublicKey, str]] = [
-            (name, addrs.worker_to_worker)
-            for name, addrs in self.committee.others_workers(self.name, self.worker_id)
+        handlers = [
+            (stake, self.sender.send(addr, sealed.message))
+            for stake, addr in self._peers
         ]
-        handlers = []
-        for peer_name, addr in peers:
-            fut = self.sender.send(addr, serialized)
-            handlers.append((self.committee.stake(peer_name), fut))
-        await self.out_queue.put((serialized, handlers))
+        item = (digest, sealed.message, handlers)
+        try:
+            self.out_queue.put_nowait(item)
+        except asyncio.QueueFull:
+            # Downstream is lagging: park the batch, stop reading clients
+            # (TCP flow control), drain asynchronously.
+            self._overflow.append(item)
+            if not self._paused:
+                self._paused = True
+                for p in self._protocols:
+                    if p.transport is not None:
+                        p.transport.pause_reading()
+                self._loop.create_task(self._drain_overflow())
+
+    async def _drain_overflow(self) -> None:
+        while self._overflow:
+            item = self._overflow.pop(0)
+            await self.out_queue.put(item)
+        self._paused = False
+        for p in self._protocols:
+            if p.transport is not None:
+                p.transport.resume_reading()

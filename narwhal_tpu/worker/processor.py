@@ -10,10 +10,15 @@ received from other workers.
 from __future__ import annotations
 
 import asyncio
+import logging
+import os
 
 from ..config import WorkerId
-from ..crypto import sha512_digest
+from ..crypto import digest32
 from ..messages import encode_batch_digest
+
+log = logging.getLogger("narwhal.worker")
+_TRACE = bool(os.environ.get("NARWHAL_TRACE"))
 
 
 class Processor:
@@ -33,9 +38,17 @@ class Processor:
 
     async def run(self) -> None:
         while True:
-            serialized = await self.in_queue.get()
-            digest = sha512_digest(serialized)
+            item = await self.in_queue.get()
+            if isinstance(item, tuple):
+                # Own batches arrive with their digest already computed at
+                # seal time (batch_maker.py) — no second 500 kB hash.
+                digest, serialized = item
+            else:
+                serialized = item
+                digest = digest32(serialized)
             self.store.write(bytes(digest), serialized)
+            if _TRACE:
+                log.info("TRACE processed %r own=%s", digest, self.own_digests)
             await self.out_queue.put(
                 encode_batch_digest(digest, self.worker_id, self.own_digests)
             )

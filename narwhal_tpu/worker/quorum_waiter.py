@@ -10,11 +10,13 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 
 from ..config import Committee
 from ..crypto import PublicKey
 
 log = logging.getLogger("narwhal.worker")
+_TRACE = bool(os.environ.get("NARWHAL_TRACE"))
 
 
 class QuorumWaiter:
@@ -33,7 +35,7 @@ class QuorumWaiter:
     async def run(self) -> None:
         threshold = self.committee.quorum_threshold()
         while True:
-            serialized, handlers = await self.in_queue.get()
+            digest, serialized, handlers = await self.in_queue.get()
             total = self.committee.stake(self.name)  # our own stake counts
             pending = {fut: stake for stake, fut in handlers}
             while total < threshold and pending:
@@ -48,6 +50,8 @@ class QuorumWaiter:
             for fut in pending:
                 fut.cancel()
             if total >= threshold:
-                await self.out_queue.put(serialized)
+                if _TRACE:
+                    log.info("TRACE quorum reached (%d B)", len(serialized))
+                await self.out_queue.put((digest, serialized))
             else:
                 log.warning("Batch dropped: quorum unreachable (got %d)", total)

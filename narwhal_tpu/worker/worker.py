@@ -14,9 +14,14 @@ import asyncio
 import logging
 from typing import List
 
+from .. import native
 from ..config import Committee, Parameters, WorkerId
 from ..crypto import PublicKey
-from ..messages import decode_primary_worker_message, decode_worker_message
+from ..messages import (
+    WORKER_BATCH,
+    decode_primary_worker_message,
+    decode_worker_message,
+)
 from ..network import Receiver, Writer
 from ..store import Store
 from .batch_maker import BatchMaker
@@ -30,16 +35,14 @@ log = logging.getLogger("narwhal.worker")
 
 CHANNEL_CAPACITY = 1_000
 
-
-class TxReceiverHandler:
-    """Client transactions: no ACK, straight into the BatchMaker
-    (reference worker.rs:243-261)."""
-
-    def __init__(self, tx_queue: asyncio.Queue) -> None:
-        self.tx_queue = tx_queue
-
-    async def dispatch(self, writer: Writer, message: bytes) -> None:
-        await self.tx_queue.put(message)
+# In-flight sealed batches awaiting their ACK quorum.  Deliberately tiny
+# (unlike the uniform 1000-capacity channels of the reference,
+# worker.rs:26): when this fills, the BatchMaker pauses the client sockets,
+# so TCP flow control adapts the offered load to the committee's real ACK
+# bandwidth.  A deep queue here is pure bufferbloat — on congested hosts the
+# ACK rate drops as the backlog grows (peers drown in queued batch frames),
+# which turns a transient stall into an unrecoverable spiral.
+QUORUM_WINDOW = 8
 
 
 class WorkerReceiverHandler:
@@ -54,18 +57,26 @@ class WorkerReceiverHandler:
         self.helper_queue = helper_queue
 
     async def dispatch(self, writer: Writer, message: bytes) -> None:
+        # Batches are large and their raw frame is the hashing/storage unit:
+        # structurally validate without decoding (native length-prefix walk,
+        # no per-tx allocation), then ACK and store the raw bytes.  A
+        # malformed batch is dropped un-ACKed, like the reference's
+        # deserialization failure path (worker.rs:264-292).
+        if message and message[0] == WORKER_BATCH:
+            if native.validate_batch(message) < 0:
+                log.warning("Dropping malformed batch frame")
+                return
+            await writer.send(b"Ack")
+            await self.others_queue.put(message)
+            return
         try:
             decoded = decode_worker_message(message)
         except ValueError as e:
             log.warning("Dropping malformed worker message: %s", e)
             return
         await writer.send(b"Ack")
-        if decoded[0] == "batch":
-            # Keep the raw frame: its bytes are the hashing/storage unit.
-            await self.others_queue.put(message)
-        else:
-            _, digests, requestor = decoded
-            await self.helper_queue.put((digests, requestor))
+        _, digests, requestor = decoded
+        await self.helper_queue.put((digests, requestor))
 
 
 class PrimaryReceiverHandler:
@@ -117,8 +128,7 @@ class Worker:
         loop = asyncio.get_running_loop()
         q = lambda: asyncio.Queue(maxsize=CHANNEL_CAPACITY)  # noqa: E731
 
-        tx_queue = q()
-        to_quorum = q()
+        to_quorum = asyncio.Queue(maxsize=QUORUM_WINDOW)
         own_batches = q()
         others_batches = q()
         to_primary = q()
@@ -128,10 +138,8 @@ class Worker:
         addrs = committee.worker(name, worker_id)
         primary_addr = committee.primary(name).worker_to_primary
 
-        # Inbound planes.
-        self.receivers.append(
-            await Receiver.spawn(addrs.transactions, TxReceiverHandler(tx_queue))
-        )
+        # Inbound planes.  The client transaction socket is bound by the
+        # BatchMaker itself (native per-tx path; see batch_maker.py).
         self.receivers.append(
             await Receiver.spawn(
                 addrs.worker_to_worker,
@@ -151,7 +159,7 @@ class Worker:
             committee,
             parameters.batch_size,
             parameters.max_batch_delay,
-            tx_queue,
+            addrs.transactions,
             to_quorum,
             benchmark=benchmark,
         )
@@ -189,6 +197,35 @@ class Worker:
             helper,
         ):
             self.tasks.append(loop.create_task(runner.run()))
+        # The tx socket is bound inside BatchMaker.run; wait so clients can
+        # connect as soon as spawn returns, and fail fast on a bind error.
+        await batch_maker.started.wait()
+        if batch_maker.boot_error is not None:
+            await self.shutdown()
+            raise batch_maker.boot_error
+
+        import os as _os
+
+        if _os.environ.get("NARWHAL_TRACE"):
+            async def heartbeat():
+                while True:
+                    t0 = loop.time()
+                    await asyncio.sleep(1.0)
+                    lag = (loop.time() - t0) - 1.0
+                    sender = batch_maker.sender
+                    buf = sum(
+                        len(c.buffer) + len(c.pending)
+                        for c in sender._connections.values()
+                    )
+                    log.info(
+                        "TRACE hb lag=%.0fms q_quorum=%d q_own=%d q_others=%d "
+                        "q_prim=%d sender_backlog=%d batcher=%d",
+                        lag * 1000, to_quorum.qsize(), own_batches.qsize(),
+                        others_batches.qsize(), to_primary.qsize(), buf,
+                        batch_maker.batcher.tx_bytes,
+                    )
+
+            self.tasks.append(loop.create_task(heartbeat()))
 
         log.info(
             "Worker %d successfully booted on %s",

@@ -1,0 +1,270 @@
+/* Native worker data plane: tx-stream framing + batch accumulation.
+ *
+ * The reference's throughput hot path is worker/src/batch_maker.rs:71-156 —
+ * per-transaction work (frame split, byte counting, sample-id scan, batch
+ * serialization) at up to hundreds of thousands of tx/s.  In this framework
+ * every per-transaction step happens here, in C, on raw buffers; Python sees
+ * only sealed ~500 kB batches (tens per second).
+ *
+ * Wire format (narwhal_tpu/utils/serde.py, network/framing.py):
+ *   tx frame on the socket:  [u32le len][len bytes]
+ *   WorkerMessage::Batch:    [u8 tag=0][u32le count][count * ([u32le len][tx])]
+ * The in-batch entry encoding equals the socket frame encoding, so the
+ * batcher accumulates inbound frame bytes verbatim and sealing is a 5-byte
+ * header prepend plus one memcpy — no per-tx re-serialization ever.
+ *
+ * Sample transactions (benchmark methodology, reference
+ * node/src/benchmark_client.rs:258-271): byte0 == 0, u64le id at bytes 1..9.
+ * Their ids are collected during accumulation so the Python side can emit
+ * the "Batch X contains sample tx N" log lines the parser joins on.
+ */
+
+#include <stdint.h>
+#include <stdlib.h>
+#include <string.h>
+
+#define DP_MAX_FRAME (32u * 1024u * 1024u) /* network/framing.py MAX_FRAME */
+
+/* ---------------------------------------------------------------- batcher */
+
+typedef struct DpBatcher {
+    uint8_t *buf;      /* batch body: concatenated [u32 len][tx] entries */
+    uint32_t len;      /* bytes used in buf */
+    uint32_t cap;
+    uint32_t tx_count;
+    uint32_t tx_bytes; /* payload bytes (sum of tx lens, excl. prefixes) */
+    uint64_t *samples;
+    uint32_t n_samples;
+    uint32_t samples_cap;
+    uint32_t batch_size; /* seal threshold on tx_bytes */
+} DpBatcher;
+
+DpBatcher *dp_batcher_new(uint32_t batch_size) {
+    DpBatcher *b = (DpBatcher *)calloc(1, sizeof(DpBatcher));
+    if (!b) return NULL;
+    b->batch_size = batch_size;
+    b->cap = batch_size + batch_size / 4 + 4096;
+    b->buf = (uint8_t *)malloc(b->cap);
+    b->samples_cap = 256;
+    b->samples = (uint64_t *)malloc(b->samples_cap * sizeof(uint64_t));
+    if (!b->buf || !b->samples) {
+        free(b->buf);
+        free(b->samples);
+        free(b);
+        return NULL;
+    }
+    return b;
+}
+
+void dp_batcher_free(DpBatcher *b) {
+    if (!b) return;
+    free(b->buf);
+    free(b->samples);
+    free(b);
+}
+
+static int dp_batcher_reserve(DpBatcher *b, uint32_t extra) {
+    if ((uint64_t)b->len + extra <= b->cap) return 0;
+    uint64_t want = (uint64_t)b->len + extra;
+    uint64_t cap = b->cap;
+    while (cap < want) cap *= 2;
+    if (cap > UINT32_MAX) return -1;
+    uint8_t *nb = (uint8_t *)realloc(b->buf, cap);
+    if (!nb) return -1;
+    b->buf = nb;
+    b->cap = (uint32_t)cap;
+    return 0;
+}
+
+/* Append one complete tx (payload only; the entry prefix is added here). */
+static int dp_batcher_push(DpBatcher *b, const uint8_t *tx, uint32_t len) {
+    if (dp_batcher_reserve(b, len + 4) != 0) return -1;
+    uint8_t *p = b->buf + b->len;
+    p[0] = (uint8_t)(len);
+    p[1] = (uint8_t)(len >> 8);
+    p[2] = (uint8_t)(len >> 16);
+    p[3] = (uint8_t)(len >> 24);
+    memcpy(p + 4, tx, len);
+    b->len += len + 4;
+    b->tx_count += 1;
+    b->tx_bytes += len;
+    if (len >= 9 && tx[0] == 0) {
+        if (b->n_samples == b->samples_cap) {
+            uint32_t nc = b->samples_cap * 2;
+            uint64_t *ns =
+                (uint64_t *)realloc(b->samples, nc * sizeof(uint64_t));
+            if (!ns) return -1;
+            b->samples = ns;
+            b->samples_cap = nc;
+        }
+        uint64_t id = 0;
+        for (int i = 7; i >= 0; i--) id = (id << 8) | tx[1 + i];
+        b->samples[b->n_samples++] = id;
+    }
+    return 0;
+}
+
+uint32_t dp_batcher_tx_bytes(const DpBatcher *b) { return b->tx_bytes; }
+uint32_t dp_batcher_tx_count(const DpBatcher *b) { return b->tx_count; }
+int dp_batcher_ready(const DpBatcher *b) {
+    return b->tx_bytes >= b->batch_size;
+}
+
+/* Size of the message dp_batcher_seal would emit right now. */
+uint32_t dp_batcher_sealed_size(const DpBatcher *b) { return 5 + b->len; }
+
+/* Seal the accumulated batch into `out` as a complete WorkerMessage::Batch
+ * (tag + count + entries).  Copies up to `samples_cap` sample ids into
+ * `samples` and the true count into *n_samples; *n_txs and *tx_bytes get
+ * the batch's tx count / payload byte count.  Resets the batcher.
+ * Returns the message length, 0 if the batch is empty, -1 if `out_cap` or
+ * `samples_cap` is too small (nothing consumed). */
+int64_t dp_batcher_seal(DpBatcher *b, uint8_t *out, uint32_t out_cap,
+                        uint64_t *samples, uint32_t samples_cap,
+                        uint32_t *n_samples, uint32_t *n_txs,
+                        uint32_t *tx_bytes) {
+    if (b->tx_count == 0) return 0;
+    uint32_t total = 5 + b->len;
+    if (out_cap < total || samples_cap < b->n_samples) return -1;
+    out[0] = 0; /* WORKER_BATCH tag */
+    uint32_t c = b->tx_count;
+    out[1] = (uint8_t)(c);
+    out[2] = (uint8_t)(c >> 8);
+    out[3] = (uint8_t)(c >> 16);
+    out[4] = (uint8_t)(c >> 24);
+    memcpy(out + 5, b->buf, b->len);
+    memcpy(samples, b->samples, b->n_samples * sizeof(uint64_t));
+    *n_samples = b->n_samples;
+    *n_txs = b->tx_count;
+    *tx_bytes = b->tx_bytes;
+    b->len = 0;
+    b->tx_count = 0;
+    b->tx_bytes = 0;
+    b->n_samples = 0;
+    return (int64_t)total;
+}
+
+/* Validate a serialized WorkerMessage::Batch (tag + count + entries) with
+ * no allocation: every entry length prefix must be in-bounds and the body
+ * must be fully consumed.  Returns the tx count, or -1 if malformed.  Used
+ * on the inter-worker receive path before a batch is ACKed and stored. */
+int64_t dp_validate_batch(const uint8_t *buf, uint32_t len) {
+    if (len < 5 || buf[0] != 0) return -1;
+    uint32_t count = (uint32_t)buf[1] | ((uint32_t)buf[2] << 8) |
+                     ((uint32_t)buf[3] << 16) | ((uint32_t)buf[4] << 24);
+    uint32_t pos = 5;
+    for (uint32_t i = 0; i < count; i++) {
+        if (len - pos < 4) return -1;
+        uint32_t flen = (uint32_t)buf[pos] | ((uint32_t)buf[pos + 1] << 8) |
+                        ((uint32_t)buf[pos + 2] << 16) |
+                        ((uint32_t)buf[pos + 3] << 24);
+        if (flen > DP_MAX_FRAME || len - pos - 4 < flen) return -1;
+        pos += 4 + flen;
+    }
+    return pos == len ? (int64_t)count : -1;
+}
+
+/* ----------------------------------------------------------------- framer */
+
+/* Per-connection splitter for the length-prefixed tx stream.  Complete
+ * frames go straight into the shared batcher; a trailing partial frame is
+ * retained for the next feed. */
+typedef struct DpFramer {
+    uint8_t *pend;
+    uint32_t pend_len;
+    uint32_t pend_cap;
+} DpFramer;
+
+DpFramer *dp_framer_new(void) {
+    DpFramer *f = (DpFramer *)calloc(1, sizeof(DpFramer));
+    if (!f) return NULL;
+    f->pend_cap = 4096;
+    f->pend = (uint8_t *)malloc(f->pend_cap);
+    if (!f->pend) {
+        free(f);
+        return NULL;
+    }
+    return f;
+}
+
+void dp_framer_free(DpFramer *f) {
+    if (!f) return;
+    free(f->pend);
+    free(f);
+}
+
+static int dp_framer_keep(DpFramer *f, const uint8_t *data, uint32_t len) {
+    if (len > f->pend_cap) {
+        uint32_t cap = f->pend_cap;
+        while (cap < len) cap *= 2;
+        uint8_t *np = (uint8_t *)realloc(f->pend, cap);
+        if (!np) return -1;
+        f->pend = np;
+        f->pend_cap = cap;
+    }
+    memmove(f->pend, data, len);
+    f->pend_len = len;
+    return 0;
+}
+
+/* Feed a socket chunk.  Transactions are appended to the batcher ONE AT A
+ * TIME with the seal threshold checked after each (matching the reference's
+ * per-tx seal check, worker/src/batch_maker.rs:77-87): when the batcher
+ * reaches its threshold mid-chunk, the remaining bytes are retained and the
+ * call returns 1 so the caller can seal and resume with an empty feed.
+ *
+ * Returns: 1 = batcher ready (seal, then call again with len 0 to drain the
+ * remainder), 0 = chunk fully consumed, -1 = malformed stream (oversized
+ * frame) or allocation failure — caller should drop the connection. */
+int dp_framer_feed(DpFramer *f, DpBatcher *b, const uint8_t *data,
+                   uint32_t len) {
+    const uint8_t *p;
+    uint32_t n;
+    uint8_t *joined = NULL;
+
+    if (f->pend_len > 0) {
+        /* Prepend the retained bytes.  Rare (once per chunk at most), so a
+         * single join allocation is fine. */
+        joined = (uint8_t *)malloc((size_t)f->pend_len + len);
+        if (!joined) return -1;
+        memcpy(joined, f->pend, f->pend_len);
+        memcpy(joined + f->pend_len, data, len);
+        p = joined;
+        n = f->pend_len + len;
+        f->pend_len = 0;
+    } else {
+        p = data;
+        n = len;
+    }
+
+    int ready = 0;
+    uint32_t pos = 0;
+    while (n - pos >= 4) {
+        if (dp_batcher_ready(b)) {
+            ready = 1;
+            break;
+        }
+        uint32_t flen = (uint32_t)p[pos] | ((uint32_t)p[pos + 1] << 8) |
+                        ((uint32_t)p[pos + 2] << 16) |
+                        ((uint32_t)p[pos + 3] << 24);
+        if (flen > DP_MAX_FRAME) {
+            free(joined);
+            return -1;
+        }
+        if (n - pos - 4 < flen) break; /* partial frame */
+        if (dp_batcher_push(b, p + pos + 4, flen) != 0) {
+            free(joined);
+            return -1;
+        }
+        pos += 4 + flen;
+    }
+    if (!ready && dp_batcher_ready(b)) ready = 1;
+    if (pos < n) {
+        if (dp_framer_keep(f, p + pos, n - pos) != 0) {
+            free(joined);
+            return -1;
+        }
+    }
+    free(joined);
+    return ready;
+}

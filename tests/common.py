@@ -52,7 +52,7 @@ def committee(base_port: int = 0, n: int = 4, workers: int = 1) -> Committee:
 
 # --- worker-plane fixtures (analog of reference worker/src/tests/common.rs) ---
 
-from narwhal_tpu.crypto import sha512_digest  # noqa: E402
+from narwhal_tpu.crypto import digest32  # noqa: E402
 from narwhal_tpu.messages import encode_batch  # noqa: E402
 
 
@@ -74,7 +74,7 @@ def serialized_batch() -> bytes:
 
 
 def batch_digest():
-    return sha512_digest(serialized_batch())
+    return digest32(serialized_batch())
 
 
 class RecordingAckHandler:

@@ -8,7 +8,7 @@ import asyncio
 import pytest
 
 from narwhal_tpu.config import Parameters
-from narwhal_tpu.crypto import SignatureService, sha512_digest
+from narwhal_tpu.crypto import SignatureService, digest32
 from narwhal_tpu.network import Receiver
 from narwhal_tpu.primary.core import AtomicRound, Core
 from narwhal_tpu.primary.messages import decode_primary_message, genesis
@@ -102,7 +102,7 @@ def test_process_header_suspends_on_missing_parents(run):
         core, store, qs = make_core(c, me)
         task = asyncio.ensure_future(core.run())
 
-        bogus_parent = sha512_digest(b"unknown certificate")
+        bogus_parent = digest32(b"unknown certificate")
         header = make_header(author, round_=2, parents={bogus_parent}, c=c)
         await qs["primaries"].put(("header", header))
         # The synchronizer must have scheduled a parent sync...
@@ -217,7 +217,7 @@ def test_vote_on_equivocating_header_only_once(run):
         task = asyncio.ensure_future(core.run())
 
         h1 = make_header(author, c=c)
-        h2 = make_header(author, payload={sha512_digest(b"x"): 0}, c=c)
+        h2 = make_header(author, payload={digest32(b"x"): 0}, c=c)
         assert h1.id != h2.id
         await qs["primaries"].put(("header", h1))
         await qs["primaries"].put(("header", h2))

@@ -8,7 +8,7 @@ from narwhal_tpu.crypto import (
     KeyPair,
     Signature,
     SignatureService,
-    sha512_digest,
+    digest32,
     verify,
     verify_batch,
     verify_batch_mask,
@@ -16,10 +16,10 @@ from narwhal_tpu.crypto import (
 
 
 def test_digest():
-    d = sha512_digest(b"hello")
+    d = digest32(b"hello")
     assert len(d) == 32
-    assert d == sha512_digest(b"hello")
-    assert d != sha512_digest(b"world")
+    assert d == digest32(b"hello")
+    assert d != digest32(b"world")
 
 
 def test_deterministic_keygen():
@@ -36,29 +36,29 @@ def test_import_export():
 
 def test_verify_valid_signature():
     kp = KeyPair.generate(bytes([2]) * 32)
-    d = sha512_digest(b"Hello, world!")
+    d = digest32(b"Hello, world!")
     sig = kp.sign(d)
     assert verify(bytes(d), kp.name, sig)
 
 
 def test_verify_invalid_signature():
     kp = KeyPair.generate(bytes([2]) * 32)
-    d = sha512_digest(b"Hello, world!")
-    bad = sha512_digest(b"tampered")
+    d = digest32(b"Hello, world!")
+    bad = digest32(b"tampered")
     sig = kp.sign(d)
     assert not verify(bytes(bad), kp.name, sig)
     assert not verify(bytes(d), kp.name, Signature.default())
 
 
 def test_verify_valid_batch():
-    d = sha512_digest(b"Hello, batch!")
+    d = digest32(b"Hello, batch!")
     kps = [KeyPair.generate(bytes([i]) * 32) for i in range(5)]
     sigs = [kp.sign(d) for kp in kps]
     assert verify_batch(d, [kp.name for kp in kps], sigs)
 
 
 def test_verify_invalid_batch():
-    d = sha512_digest(b"Hello, batch!")
+    d = digest32(b"Hello, batch!")
     kps = [KeyPair.generate(bytes([i]) * 32) for i in range(5)]
     sigs = [kp.sign(d) for kp in kps]
     sigs[2] = Signature.default()
@@ -73,7 +73,7 @@ def test_signature_service():
     async def go():
         kp = KeyPair.generate(bytes([3]) * 32)
         service = SignatureService(kp)
-        d = sha512_digest(b"service")
+        d = digest32(b"service")
         sig = await service.request_signature(d)
         assert verify(bytes(d), kp.name, sig)
 

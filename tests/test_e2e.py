@@ -52,12 +52,12 @@ def test_four_node_commit(run):
 
         # batch_size=400 seals every 4 of our 100 B txs into one batch; wait
         # until BOTH batches commit at every node.
-        from narwhal_tpu.crypto import sha512_digest
+        from narwhal_tpu.crypto import digest32
         from narwhal_tpu.messages import encode_batch
 
         expected = {
-            sha512_digest(encode_batch(txs[:4])),
-            sha512_digest(encode_batch(txs[4:])),
+            digest32(encode_batch(txs[:4])),
+            digest32(encode_batch(txs[4:])),
         }
 
         def payload_committed(certs):

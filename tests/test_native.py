@@ -1,0 +1,197 @@
+"""Native data plane (native/dataplane.c via ctypes) vs the Python twin:
+identical sealed messages, sample extraction, and chunk-boundary handling.
+The sealed message must be byte-identical to messages.encode_batch of the
+same transactions."""
+
+import random
+
+import pytest
+
+from narwhal_tpu import native
+from narwhal_tpu.messages import encode_batch
+from narwhal_tpu.network.framing import frame
+
+
+def _txs(rng, n, size=64):
+    out = []
+    for i in range(n):
+        if rng.random() < 0.2:
+            tx = b"\x00" + rng.getrandbits(64).to_bytes(8, "little")
+            tx += bytes(size - len(tx))
+        else:
+            tx = b"\x01" + rng.randbytes(size - 1)
+        out.append(tx)
+    return out
+
+
+def _stream(txs):
+    return b"".join(frame(tx) for tx in txs)
+
+
+def _impls():
+    impls = [("python", native._PyBatcher, native._PyFramer)]
+    if native.native_available():
+        lib = native._load()
+        impls.append((
+            "native",
+            lambda size: native._NativeBatcher(lib, size),
+            lambda: native._NativeFramer(lib),
+        ))
+    return impls
+
+
+@pytest.mark.parametrize("name,mk_batcher,mk_framer", _impls())
+def test_seal_matches_encode_batch(name, mk_batcher, mk_framer):
+    rng = random.Random(0)
+    txs = _txs(rng, 100)
+    batcher = mk_batcher(1 << 20)
+    framer = mk_framer()
+    framer.feed(batcher, _stream(txs))
+    assert batcher.tx_count == 100
+    assert batcher.tx_bytes == sum(len(t) for t in txs)
+    sealed = batcher.seal()
+    assert sealed.message == encode_batch(txs)
+    assert sealed.tx_count == 100
+    want_samples = [
+        int.from_bytes(t[1:9], "little") for t in txs if t[0] == 0
+    ]
+    assert sealed.samples == want_samples
+    # Batcher resets after seal.
+    assert batcher.tx_count == 0 and batcher.seal() is None
+
+
+@pytest.mark.parametrize("name,mk_batcher,mk_framer", _impls())
+def test_chunk_boundaries(name, mk_batcher, mk_framer):
+    """Feeding the same stream in adversarially small/uneven chunks must
+    produce the same batch (partial frames span feeds)."""
+    rng = random.Random(1)
+    txs = _txs(rng, 50, size=37)
+    stream = _stream(txs)
+    batcher = mk_batcher(1 << 20)
+    framer = mk_framer()
+    pos = 0
+    while pos < len(stream):
+        n = rng.randint(1, 11)
+        framer.feed(batcher, stream[pos : pos + n])
+        pos += n
+    sealed = batcher.seal()
+    assert sealed.message == encode_batch(txs)
+
+
+@pytest.mark.parametrize("name,mk_batcher,mk_framer", _impls())
+def test_multiple_connections_share_batcher(name, mk_batcher, mk_framer):
+    """Per-connection framers feeding one shared batcher interleave whole
+    transactions (never partial bytes)."""
+    rng = random.Random(2)
+    txs_a, txs_b = _txs(rng, 20), _txs(rng, 20)
+    batcher = mk_batcher(1 << 20)
+    fa, fb = mk_framer(), mk_framer()
+    sa, sb = _stream(txs_a), _stream(txs_b)
+    # Interleave partial feeds from two connections.
+    fa.feed(batcher, sa[:100])
+    fb.feed(batcher, sb[:33])
+    fa.feed(batcher, sa[100:])
+    fb.feed(batcher, sb[33:])
+    sealed = batcher.seal()
+    assert sealed.tx_count == 40
+    # Every tx present exactly once (order depends on interleave).
+    from narwhal_tpu.messages import decode_worker_message
+
+    kind, batch = decode_worker_message(sealed.message)
+    assert kind == "batch"
+    assert sorted(batch) == sorted(txs_a + txs_b)
+
+
+@pytest.mark.parametrize("name,mk_batcher,mk_framer", _impls())
+def test_ready_threshold(name, mk_batcher, mk_framer):
+    batcher = mk_batcher(100)
+    framer = mk_framer()
+    framer.feed(batcher, frame(bytes(60)))
+    assert not batcher.ready()
+    framer.feed(batcher, frame(bytes(60)))
+    assert batcher.ready()
+
+
+@pytest.mark.parametrize("name,mk_batcher,mk_framer", _impls())
+def test_threshold_splits_mid_chunk(name, mk_batcher, mk_framer):
+    """One big chunk must seal at tx granularity (reference
+    batch_maker.rs:77-87 checks the threshold per tx): 8×100 B txs with a
+    400 B threshold yield two 4-tx batches, not one 8-tx batch."""
+    txs = [bytes([1]) + i.to_bytes(8, "little") + bytes(91) for i in range(8)]
+    batcher = mk_batcher(400)
+    framer = mk_framer()
+    sealed = []
+    more = framer.feed(batcher, _stream(txs))
+    while more:
+        sealed.append(batcher.seal())
+        more = framer.feed(batcher, b"")
+    if batcher.tx_count:
+        sealed.append(batcher.seal())
+    assert [s.tx_count for s in sealed] == [4, 4]
+    assert sealed[0].message == encode_batch(txs[:4])
+    assert sealed[1].message == encode_batch(txs[4:])
+
+
+@pytest.mark.parametrize("name,mk_batcher,mk_framer", _impls())
+def test_oversized_frame_rejected(name, mk_batcher, mk_framer):
+    batcher = mk_batcher(100)
+    framer = mk_framer()
+    import struct
+
+    bad = struct.pack("<I", 33 * 1024 * 1024)
+    with pytest.raises(ValueError):
+        framer.feed(batcher, bad + b"xxxx")
+
+
+def test_validate_batch():
+    rng = random.Random(3)
+    txs = _txs(rng, 10)
+    msg = encode_batch(txs)
+    assert native.validate_batch(msg) == 10
+    # Tag mismatch, truncation, count lies, oversized entry: all rejected.
+    assert native.validate_batch(b"\x01" + msg[1:]) == -1
+    assert native.validate_batch(msg[:-1]) == -1
+    assert native.validate_batch(msg + b"x") == -1
+    bad = bytearray(msg)
+    bad[1] = 11  # count claims one more tx than present
+    assert native.validate_batch(bytes(bad)) == -1
+    import struct as _s
+
+    huge = b"\x00" + _s.pack("<I", 1) + _s.pack("<I", 33 * 1024 * 1024)
+    assert native.validate_batch(huge) == -1
+    # The Python twin agrees.
+    lib, native._lib = native._lib, None
+    try:
+        builder = native._load  # force fallback by masking the lib
+        native._load = lambda: None
+        assert native.validate_batch(msg) == 10
+        assert native.validate_batch(msg[:-1]) == -1
+    finally:
+        native._load = builder
+        native._lib = lib
+
+
+def test_store_truncates_torn_tail(tmp_path):
+    """A torn record is physically truncated on replay, so post-recovery
+    appends stay replayable (not shadowed by tail garbage)."""
+    from narwhal_tpu.store import Store
+
+    path = str(tmp_path / "store.log")
+    s = Store(path)
+    s.write(b"k1", b"v1")
+    s.close()
+    with open(path, "ab") as f:
+        f.write(b"\xff\xff\xff")  # torn tail from a crash mid-write
+    s2 = Store(path)
+    assert s2.read(b"k1") == b"v1"
+    s2.write(b"k2", b"v2")
+    s2.close()
+    s3 = Store(path)
+    assert s3.read(b"k1") == b"v1" and s3.read(b"k2") == b"v2"
+    s3.close()
+
+
+def test_native_is_available():
+    """This environment has a C toolchain; the real library must build —
+    the Python twin is a fallback for exotic deploys, not for CI."""
+    assert native.native_available()

@@ -5,7 +5,7 @@ import asyncio
 
 import pytest
 
-from narwhal_tpu.crypto import SignatureService, sha512_digest
+from narwhal_tpu.crypto import SignatureService, digest32
 from narwhal_tpu.primary.messages import genesis
 from narwhal_tpu.primary.proposer import Proposer
 from tests.common import committee, keys
@@ -62,7 +62,7 @@ def test_payload_header_by_size(run):
             c, kp, header_size=32, delay_ms=60_000
         )
         task = asyncio.ensure_future(p.run())
-        digest = sha512_digest(b"batch")
+        digest = digest32(b"batch")
         await rx_workers.put((digest, 3))
         header = await asyncio.wait_for(tx_core.get(), 5)
         assert header.payload == {digest: 3} and header.round == 1
@@ -84,7 +84,7 @@ def test_round_advance_requires_parents(run):
         await asyncio.sleep(0.3)
         assert tx_core.empty()
         # Parents for round 1 arrive: round advances and a header appears.
-        parents = [sha512_digest(bytes([i]) * 3) for i in range(3)]
+        parents = [digest32(bytes([i]) * 3) for i in range(3)]
         await rx_core.put((parents, 1))
         second = await asyncio.wait_for(tx_core.get(), 5)
         assert second.round == 2 and second.parents == set(parents)

@@ -9,7 +9,7 @@ import asyncio
 import pytest
 
 from narwhal_tpu.config import Parameters
-from narwhal_tpu.crypto import sha512_digest
+from narwhal_tpu.crypto import digest32
 from narwhal_tpu.messages import (
     decode_worker_message,
     decode_worker_primary_message,
@@ -55,20 +55,35 @@ async def spawn_peer_listeners(c, myself, worker_id=0, ack=True):
     return handlers, receivers
 
 
+async def connect_and_send(maker, txs):
+    """Open a client connection to the maker's tx socket and write frames."""
+    from narwhal_tpu.network.framing import write_frame
+
+    await maker.started.wait()
+    host, port = maker.address.rsplit(":", 1)
+    _, w = await asyncio.open_connection(host, int(port))
+    for tx in txs:
+        await write_frame(w, tx)
+    return w
+
+
 def test_batch_maker_seals_by_size(run):
     async def go():
         c = committee(base_port=11000)
         me = keys()[0].name
         handlers, receivers = await spawn_peer_listeners(c, me)
-        tx_q, out_q = asyncio.Queue(), asyncio.Queue()
+        out_q = asyncio.Queue()
         maker = BatchMaker(me, 0, c, batch_size=200, max_batch_delay_ms=10_000,
-                           tx_queue=tx_q, out_queue=out_q)
+                           address=c.worker(me, 0).transactions, out_queue=out_q)
         task = asyncio.ensure_future(maker.run())
-        for tx in (transaction(), transaction()):
-            await tx_q.put(tx)
-        serialized, quorum_handlers = await asyncio.wait_for(out_q.get(), 5)
+        w = await connect_and_send(maker, [transaction(), transaction()])
+        digest, serialized, quorum_handlers = await asyncio.wait_for(
+            out_q.get(), 5
+        )
+        w.close()
         kind, decoded = decode_worker_message(serialized)
         assert kind == "batch" and decoded == [transaction(), transaction()]
+        assert digest == digest32(serialized)
         assert len(quorum_handlers) == 3  # one ACK future per other authority
         task.cancel()
         maker.sender.close()
@@ -83,12 +98,13 @@ def test_batch_maker_seals_by_timeout(run):
         c = committee(base_port=11020)
         me = keys()[0].name
         handlers, receivers = await spawn_peer_listeners(c, me)
-        tx_q, out_q = asyncio.Queue(), asyncio.Queue()
+        out_q = asyncio.Queue()
         maker = BatchMaker(me, 0, c, batch_size=1_000_000, max_batch_delay_ms=50,
-                           tx_queue=tx_q, out_queue=out_q)
+                           address=c.worker(me, 0).transactions, out_queue=out_q)
         task = asyncio.ensure_future(maker.run())
-        await tx_q.put(transaction())
-        serialized, _ = await asyncio.wait_for(out_q.get(), 5)
+        w = await connect_and_send(maker, [transaction()])
+        _, serialized, _ = await asyncio.wait_for(out_q.get(), 5)
+        w.close()
         kind, decoded = decode_worker_message(serialized)
         assert kind == "batch" and decoded == [transaction()]
         task.cancel()
@@ -104,15 +120,15 @@ def test_quorum_waiter_releases_at_2f1(run):
         c = committee(base_port=11040)
         me = keys()[0].name
         handlers, receivers = await spawn_peer_listeners(c, me)
-        tx_q, to_quorum, released = asyncio.Queue(), asyncio.Queue(), asyncio.Queue()
+        to_quorum, released = asyncio.Queue(), asyncio.Queue()
         maker = BatchMaker(me, 0, c, batch_size=200, max_batch_delay_ms=10_000,
-                           tx_queue=tx_q, out_queue=to_quorum)
+                           address=c.worker(me, 0).transactions, out_queue=to_quorum)
         waiter = QuorumWaiter(me, c, to_quorum, released)
         t1 = asyncio.ensure_future(maker.run())
         t2 = asyncio.ensure_future(waiter.run())
-        for tx in (transaction(), transaction()):
-            await tx_q.put(tx)
-        serialized = await asyncio.wait_for(released.get(), 10)
+        w = await connect_and_send(maker, [transaction(), transaction()])
+        _, serialized = await asyncio.wait_for(released.get(), 10)
+        w.close()
         assert decode_worker_message(serialized)[0] == "batch"
         # All three peers eventually saw the broadcast.
         for h in handlers:
@@ -233,7 +249,7 @@ def test_worker_end_to_end(run):
         await asyncio.wait_for(primary_handler.arrived.wait(), 10)
         decoded = decode_worker_primary_message(primary_handler.received[0])
         assert decoded.ours and decoded.worker_id == 0
-        expected = sha512_digest(encode_batch(txs))
+        expected = digest32(encode_batch(txs))
         assert decoded.digest == expected
         w.close()
         await worker.shutdown()
