@@ -34,11 +34,13 @@ def main() -> None:
     rate = int(os.environ.get("BENCH_RATE", "55000"))
     nodes = int(os.environ.get("BENCH_NODES", "4"))
     batch = int(os.environ.get("BENCH_BATCH", "125000"))
-    runs = int(os.environ.get("BENCH_RUNS", "2"))
+    runs = int(os.environ.get("BENCH_RUNS", "3"))
 
     # A saturation benchmark on a shared-core host is noisy (scheduling
-    # jitter decides when congestion onset hits); run a few times and report
-    # the best sustained run, listing every run in the JSON.
+    # jitter decides when congestion onset hits); run several times and
+    # report the MEDIAN run (robust against one lucky or one degraded run;
+    # unlike max-of-N it does not inflate with more runs), listing every
+    # run in the JSON.
     results = []
     for _ in range(max(1, runs)):
         results.append(
@@ -53,7 +55,8 @@ def main() -> None:
                 quiet=True,
             )
         )
-    result = max(results, key=lambda r: r.end_to_end_tps)
+    ranked = sorted(results, key=lambda r: r.end_to_end_tps)
+    result = ranked[len(ranked) // 2]
     if result.end_to_end_tps > 0:
         metric, tps, baseline = (
             "end_to_end_tps_local_4n",

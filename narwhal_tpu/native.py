@@ -35,25 +35,39 @@ _build_attempted = False
 
 
 def ensure_built() -> bool:
-    """Build the native library if missing/stale. Returns availability."""
+    """Build the native library if missing/stale. Returns availability.
+    A stale library is never used: if the rebuild fails, we fall back to
+    the Python twin rather than dlopen an ABI that may no longer match
+    the ctypes signatures."""
     global _build_attempted
     if not os.path.exists(_SRC_PATH):
         return False
-    fresh = (
-        os.path.exists(_LIB_PATH)
-        and os.path.getmtime(_LIB_PATH) >= os.path.getmtime(_SRC_PATH)
-    )
-    if fresh:
+
+    def fresh() -> bool:
+        return (
+            os.path.exists(_LIB_PATH)
+            and os.path.getmtime(_LIB_PATH) >= os.path.getmtime(_SRC_PATH)
+        )
+
+    if fresh():
         return True
     if _build_attempted:
-        return os.path.exists(_LIB_PATH)
+        return False
     _build_attempted = True
     try:
-        subprocess.run(
-            ["make", "-s"], cwd=_NATIVE_DIR, check=True,
-            capture_output=True, timeout=120,
-        )
-        return True
+        # Cross-process lock: the bench harness spawns many nodes at once
+        # and they must not run `make` over the same output concurrently
+        # (the Makefile also builds via tmp + atomic rename).
+        import fcntl
+
+        with open(os.path.join(_NATIVE_DIR, ".build.lock"), "w") as lockf:
+            fcntl.flock(lockf, fcntl.LOCK_EX)
+            if not fresh():
+                subprocess.run(
+                    ["make", "-s"], cwd=_NATIVE_DIR, check=True,
+                    capture_output=True, timeout=120,
+                )
+        return fresh()
     except (subprocess.SubprocessError, OSError) as e:
         log.warning("native data plane build failed, using Python fallback: %s", e)
         return False
@@ -192,8 +206,9 @@ class _NativeFramer:
 
 # ------------------------------------------------------------- Python twin
 
+from .network.framing import MAX_FRAME as _MAX_FRAME  # single source of truth
+
 _U32 = struct.Struct("<I")
-_MAX_FRAME = 32 * 1024 * 1024
 
 
 class _PyBatcher:

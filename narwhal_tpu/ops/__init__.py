@@ -10,3 +10,20 @@
 Import is deferred by callers (crypto.backend, consensus) so the pure-CPU
 protocol path never pays the JAX import cost.
 """
+
+import os as _os
+
+import jax as _jax
+
+# Persistent XLA compilation cache: the verify/commit kernels take tens of
+# seconds to compile on a TPU terminal; cache them across node processes
+# (every primary spawns fresh in the bench harness).
+_cache_dir = _os.environ.get(
+    "NARWHAL_JAX_CACHE",
+    _os.path.join(_os.path.expanduser("~"), ".cache", "narwhal_tpu_jax"),
+)
+try:
+    _jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    _jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+except Exception:  # older jax without the knob: compile per-process
+    pass

@@ -1,0 +1,214 @@
+"""GF(2^255 - 19) arithmetic from 32-bit integer lanes, batch-first.
+
+TPU has no native 64-bit multiply, so field elements are 20 limbs of 13
+bits (radix 2^13) held in int32: limb products are ≤ 26 bits and a full
+schoolbook row sum (≤ 20 terms) stays under 2^31 — every intermediate fits
+an int32 lane with no emulated wide arithmetic.  This is the TPU-shaped
+answer to the reference's ed25519-dalek (crypto/src/lib.rs:206-219), whose
+Rust backend uses 51-bit limbs in u128 — a layout that cannot map to VPU
+lanes.
+
+All functions are batch-first: an element is ``int32[..., 20]`` and every
+op vmaps/broadcasts over leading axes.  Limb i holds bits [13i, 13i+13).
+Outputs of mul/add/sub are *weakly reduced* (13-bit limbs, value possibly
+in [p, 2^260)); ``canon`` fully reduces into [0, p).
+
+Correctness strategy: every op is differential-tested against Python big
+ints over random + boundary values (tests/test_ed25519.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+BITS = 13
+LIMBS = 20
+MASK = (1 << BITS) - 1
+P = (1 << 255) - 19
+
+# 2^260 ≡ 2^5 · 19 (mod p): folding multiplier for limbs ≥ LIMBS.
+FOLD = 19 << 5  # 608
+
+
+def to_limbs(x: int) -> np.ndarray:
+    """Python int → limb vector (host-side prep)."""
+    return np.array([(x >> (BITS * i)) & MASK for i in range(LIMBS)],
+                    dtype=np.int32)
+
+
+def from_limbs(limbs) -> int:
+    """Limb vector → Python int (host-side check); accepts unreduced."""
+    arr = np.asarray(limbs, dtype=np.int64)
+    return sum(int(v) << (BITS * i) for i, v in enumerate(arr))
+
+
+def _carry_once(c: jnp.ndarray) -> jnp.ndarray:
+    """One vectorized carry sweep; the carry out of the top limb wraps to
+    limb 0 multiplied by 608 (2^260 ≡ 608 mod p)."""
+    hi = c >> BITS
+    lo = c & MASK
+    out = lo.at[..., 1:].add(hi[..., :-1])
+    return out.at[..., 0].add(hi[..., -1] * FOLD)
+
+
+def carry(c: jnp.ndarray) -> jnp.ndarray:
+    """Propagate carries until every limb is back in [0, 2^13).  Input
+    limbs may be up to 2^31; four sweeps suffice: ≤2^13+2^18 after one,
+    ≤2^13+2^5 after two, ≤2^13+1 after three, <2^13 after four (the ×608
+    wrap feeding limb 0 is absorbed the same way)."""
+    for _ in range(4):
+        c = _carry_once(c)
+    return c
+
+
+# c[k] = Σ_{i+j=k} a_i·b_j via a one-hot convolution tensor → one batched
+# int32 matmul the compiler can tile.  ANTI[i·L+j, k] = [i + j == k].
+_ANTI = np.zeros((LIMBS, LIMBS, 2 * LIMBS - 1), dtype=np.int32)
+for _i in range(LIMBS):
+    for _j in range(LIMBS):
+        _ANTI[_i, _j, _i + _j] = 1
+_ANTI_FLAT = jnp.asarray(_ANTI.reshape(LIMBS * LIMBS, 2 * LIMBS - 1))
+
+
+def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Field multiply, weakly reduced output."""
+    outer = (a[..., :, None] * b[..., None, :]).reshape(
+        a.shape[:-1] + (LIMBS * LIMBS,)
+    )
+    conv = jnp.matmul(outer, _ANTI_FLAT)  # [..., 39], each ≤ 20·2^26 < 2^31
+    # Fold limbs ≥ 20: 2^(13(20+j)) ≡ 608·2^(13j) (mod p).  Split each high
+    # limb first so the ×608 stays in int32: parts are ≤ 2^13 and ≤ 2^18,
+    # and 608·2^18 < 2^28.
+    hi = conv[..., LIMBS:]
+    lo = conv[..., :LIMBS]
+    folded = (
+        lo.at[..., : LIMBS - 1].add((hi & MASK) * FOLD)
+        .at[..., 1:LIMBS].add((hi >> BITS) * FOLD)
+    )
+    return carry(folded)
+
+
+def square(a: jnp.ndarray) -> jnp.ndarray:
+    return mul(a, a)
+
+
+def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return carry(a + b)
+
+
+# Borrow-free subtraction needs a limb vector ZP whose value is ≡ 0 (mod p)
+# with EVERY limb ≥ 2^13 (an upper bound on a weakly-reduced operand's
+# limbs): then (a + ZP - b) is non-negative per limb and carry() reduces it.
+# Construct: put 2·MASK in every limb, then add the canonical limbs of the
+# complement that makes the total a multiple of p.
+_base = sum(2 * MASK << (BITS * i) for i in range(LIMBS))
+_comp = (-_base) % P
+_zp = [2 * MASK + ((_comp >> (BITS * i)) & MASK) for i in range(LIMBS)]
+assert sum(v << (BITS * i) for i, v in enumerate(_zp)) % P == 0
+assert all((1 << BITS) <= v < (1 << 15) for v in _zp)
+_ZP = jnp.asarray(np.array(_zp, dtype=np.int32))
+
+
+def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """a - b (mod p): the ZP offset keeps every limb non-negative."""
+    return carry(a + _ZP - b)
+
+
+def neg(a: jnp.ndarray) -> jnp.ndarray:
+    return carry(_ZP - a)
+
+
+def mul_small(a: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Multiply by a small non-negative constant (k ≤ 2^17)."""
+    return carry(a * jnp.int32(k))
+
+
+def pow2k(a: jnp.ndarray, k: int) -> jnp.ndarray:
+    """a^(2^k) — k repeated squarings (fori_loop: one compiled body)."""
+    return jax.lax.fori_loop(0, k, lambda _, x: square(x), a)
+
+
+def invert(a: jnp.ndarray) -> jnp.ndarray:
+    """a^(p-2) — Fermat inversion, standard 2^255-21 addition chain."""
+    x1 = a
+    x2 = mul(square(x1), x1)          # 2^2 - 1
+    x4 = mul(pow2k(x2, 2), x2)        # 2^4 - 1
+    x5 = mul(square(x4), x1)          # 2^5 - 1
+    x10 = mul(pow2k(x5, 5), x5)       # 2^10 - 1
+    x20 = mul(pow2k(x10, 10), x10)    # 2^20 - 1
+    x40 = mul(pow2k(x20, 20), x20)    # 2^40 - 1
+    x50 = mul(pow2k(x40, 10), x10)    # 2^50 - 1
+    x100 = mul(pow2k(x50, 50), x50)   # 2^100 - 1
+    x200 = mul(pow2k(x100, 100), x100)  # 2^200 - 1
+    x250 = mul(pow2k(x200, 50), x50)  # 2^250 - 1
+    # p - 2 = 2^255 - 21 = (2^250-1)·2^5 + 11;  11 = 0b01011
+    t = pow2k(x250, 5)
+    return mul(t, mul(mul(square(square(square(x1))), square(x1)), x1))
+
+
+def pow_p58(a: jnp.ndarray) -> jnp.ndarray:
+    """a^((p-5)/8) = a^(2^252 - 3) = (a^(2^250-1))^4 · a."""
+    x1 = a
+    x2 = mul(square(x1), x1)
+    x4 = mul(pow2k(x2, 2), x2)
+    x5 = mul(square(x4), x1)
+    x10 = mul(pow2k(x5, 5), x5)
+    x20 = mul(pow2k(x10, 10), x10)
+    x40 = mul(pow2k(x20, 20), x20)
+    x50 = mul(pow2k(x40, 10), x10)
+    x100 = mul(pow2k(x50, 50), x50)
+    x200 = mul(pow2k(x100, 100), x100)
+    x250 = mul(pow2k(x200, 50), x50)
+    return mul(pow2k(x250, 2), x1)
+
+
+_P_LIMBS = jnp.asarray(to_limbs(P))
+
+
+def _sub_p(c: jnp.ndarray):
+    """(c - p) with full borrow propagation.  Returns (limbs, underflow):
+    underflow True means c < p (result invalid, keep c)."""
+    d = c - _P_LIMBS
+    d_first = jnp.moveaxis(d, -1, 0)  # [LIMBS, ...]
+
+    def step(borrow, d_i):
+        v = d_i - borrow
+        neg_ = v < 0
+        v = v + jnp.where(neg_, jnp.int32(1 << BITS), jnp.int32(0))
+        return jnp.where(neg_, jnp.int32(1), jnp.int32(0)), v
+
+    borrow0 = jnp.zeros(c.shape[:-1], dtype=jnp.int32)
+    borrow, limbs = jax.lax.scan(step, borrow0, d_first)
+    return jnp.moveaxis(limbs, 0, -1), borrow > 0
+
+
+def canon(a: jnp.ndarray) -> jnp.ndarray:
+    """Fully reduce into [0, p) with strictly canonical limbs (< 2^13)."""
+    c = carry(a)
+    # carry() can leave a limb at exactly 2^13 (carry-in onto a full limb),
+    # and one sweep only moves such a spike up one position — run LIMBS+2
+    # sweeps so any spike exits the top and wraps to a small limb-0 term.
+    for _ in range(LIMBS + 2):
+        c = _carry_once(c)
+    # Value is now < 2^260 ≈ 32·p: strip multiples of p by conditional
+    # subtraction until below p.
+    for _ in range(33):
+        d, under = _sub_p(c)
+        c = jnp.where(under[..., None], c, d)
+    return c
+
+
+def is_zero(a: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(canon(a) == 0, axis=-1)
+
+
+def eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(canon(a) == canon(b), axis=-1)
+
+
+def select(cond: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """cond ? a : b, with cond shaped [...] and a/b [..., LIMBS]."""
+    return jnp.where(cond[..., None], a, b)

@@ -94,6 +94,7 @@ class BatchMaker:
         self._protocols: set = set()
         self._paused = False
         self._overflow: List = []
+        self._drain_task: Optional[asyncio.Task] = None
         self._server: Optional[asyncio.AbstractServer] = None
         self._deadline: Optional[float] = None
         self._dirty = asyncio.Event()
@@ -138,6 +139,8 @@ class BatchMaker:
                     continue  # re-check: a size-seal may have intervened
                 self._seal()
         finally:
+            if self._drain_task is not None:
+                self._drain_task.cancel()
             self._server.close()
             for p in list(self._protocols):
                 if p.transport is not None:
@@ -195,7 +198,9 @@ class BatchMaker:
                 for p in self._protocols:
                     if p.transport is not None:
                         p.transport.pause_reading()
-                self._loop.create_task(self._drain_overflow())
+                self._drain_task = self._loop.create_task(
+                    self._drain_overflow()
+                )
 
     async def _drain_overflow(self) -> None:
         while self._overflow:

@@ -1,0 +1,103 @@
+"""Differential tests: GF(2^255-19) limb arithmetic vs Python big ints."""
+
+import random
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from narwhal_tpu.ops import field25519 as F  # noqa: E402
+
+P = F.P
+rng = random.Random(0)
+
+EDGE = [0, 1, 2, 19, (1 << 255) - 20, P - 1, P - 2, (1 << 252), MASK8 := (1 << 13) - 1]
+
+
+def rand_elems(n):
+    vals = EDGE + [rng.randrange(P) for _ in range(n - len(EDGE))]
+    return vals[:n]
+
+
+def batch(vals):
+    return jnp.asarray(np.stack([F.to_limbs(v) for v in vals]))
+
+
+def test_roundtrip():
+    vals = rand_elems(32)
+    got = [F.from_limbs(x) for x in np.asarray(batch(vals))]
+    assert got == vals
+
+
+def test_add_sub_neg():
+    a_vals, b_vals = rand_elems(64), list(reversed(rand_elems(64)))
+    a, b = batch(a_vals), batch(b_vals)
+    s = np.asarray(F.canon(F.add(a, b)))
+    d = np.asarray(F.canon(F.sub(a, b)))
+    n = np.asarray(F.canon(F.neg(a)))
+    for i, (x, y) in enumerate(zip(a_vals, b_vals)):
+        assert F.from_limbs(s[i]) == (x + y) % P
+        assert F.from_limbs(d[i]) == (x - y) % P
+        assert F.from_limbs(n[i]) == (-x) % P
+
+
+def test_mul_square():
+    a_vals, b_vals = rand_elems(64), list(reversed(rand_elems(64)))
+    a, b = batch(a_vals), batch(b_vals)
+    m = np.asarray(F.canon(F.mul(a, b)))
+    sq = np.asarray(F.canon(F.square(a)))
+    for i, (x, y) in enumerate(zip(a_vals, b_vals)):
+        assert F.from_limbs(m[i]) == (x * y) % P, f"mul row {i}"
+        assert F.from_limbs(sq[i]) == (x * x) % P, f"sq row {i}"
+
+
+def test_mul_chain_stays_reduced():
+    """Repeated muls never overflow int32 lanes (weak reduction bound)."""
+    a_vals = rand_elems(16)
+    a = batch(a_vals)
+    acc = a
+    expect = list(a_vals)
+    for _ in range(50):
+        acc = F.mul(acc, a)
+        assert int(jnp.max(acc)) <= (1 << 13), "limb escaped weak bound"
+        expect = [(e * x) % P for e, x in zip(expect, a_vals)]
+    got = np.asarray(F.canon(acc))
+    for i, e in enumerate(expect):
+        assert F.from_limbs(got[i]) == e
+
+
+def test_invert():
+    vals = [v for v in rand_elems(32) if v != 0]
+    a = batch(vals)
+    inv = np.asarray(F.canon(F.invert(a)))
+    for i, v in enumerate(vals):
+        assert F.from_limbs(inv[i]) == pow(v, P - 2, P)
+
+
+def test_pow_p58():
+    vals = rand_elems(16)
+    a = batch(vals)
+    r = np.asarray(F.canon(F.pow_p58(a)))
+    e = (P - 5) // 8
+    for i, v in enumerate(vals):
+        assert F.from_limbs(r[i]) == pow(v, e, P)
+
+
+def test_canon_and_eq():
+    # p and 0 are the same element; 2^255-19+x ≡ x.
+    a = batch([P, 0, P + 5, 5])
+    c = np.asarray(F.canon(a))
+    assert F.from_limbs(c[0]) == 0 and F.from_limbs(c[2]) == 5
+    assert bool(F.eq(a[0], a[1])) and bool(F.eq(a[2], a[3]))
+    assert not bool(F.eq(a[1], a[3]))
+    assert bool(F.is_zero(a[0])) and not bool(F.is_zero(a[3]))
+
+
+def test_mul_small():
+    vals = rand_elems(16)
+    a = batch(vals)
+    r = np.asarray(F.canon(F.mul_small(a, 121666)))
+    for i, v in enumerate(vals):
+        assert F.from_limbs(r[i]) == (v * 121666) % P
