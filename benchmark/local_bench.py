@@ -96,6 +96,16 @@ def run_bench(
     workdir = workdir or os.path.join(REPO, ".bench")
     shutil.rmtree(workdir, ignore_errors=True)
     os.makedirs(workdir, exist_ok=True)
+    # Node stores go on tmpfs when available: a 25 s run writes several GB
+    # of batch logs, and on a shared-core host the disk writeback of run N
+    # steals the core from run N+1 (kworker/flush), corrupting the
+    # measurement.  The reference benches on local NVMe where this doesn't
+    # bite; tmpfs gives the same effective behavior here.
+    storedir = workdir
+    if os.path.isdir("/dev/shm"):
+        storedir = "/dev/shm/narwhal_bench"
+        shutil.rmtree(storedir, ignore_errors=True)
+        os.makedirs(storedir, exist_ok=True)
 
     keypairs = [KeyPair.generate() for _ in range(nodes)]
     committee = build_committee(keypairs, base_port, workers)
@@ -139,7 +149,7 @@ def run_bench(
                 "--parameters",
                 f"{workdir}/parameters.json",
                 "--store",
-                f"{workdir}/db-primary-{i}",
+                f"{storedir}/db-primary-{i}",
                 "--benchmark",
                 "primary",
             ],
@@ -161,7 +171,7 @@ def run_bench(
                     "--parameters",
                     f"{workdir}/parameters.json",
                     "--store",
-                    f"{workdir}/db-worker-{i}-{wid}",
+                    f"{storedir}/db-worker-{i}-{wid}",
                     "--benchmark",
                     "worker",
                     "--id",
@@ -224,10 +234,10 @@ def run_bench(
     )
     if not keep_logs:
         for i in range(alive):
-            shutil.rmtree(f"{workdir}/db-primary-{i}", ignore_errors=True)
+            shutil.rmtree(f"{storedir}/db-primary-{i}", ignore_errors=True)
             for wid in range(workers):
                 shutil.rmtree(
-                    f"{workdir}/db-worker-{i}-{wid}", ignore_errors=True
+                    f"{storedir}/db-worker-{i}-{wid}", ignore_errors=True
                 )
     return result
 

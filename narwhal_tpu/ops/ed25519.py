@@ -270,9 +270,10 @@ def _bits_le(raw: np.ndarray) -> np.ndarray:
 
 
 def _field_limbs(bits: np.ndarray) -> np.ndarray:
-    """bit matrix [B, 256] (low 255 bits used) → int32[B, 20] limbs."""
+    """bit matrix [B, 256] (low 255 bits used) → int32[B, LIMBS] limbs."""
+    pad = F.LIMBS * F.BITS - 255
     padded = np.concatenate(
-        [bits[:, :255], np.zeros((bits.shape[0], 5), bits.dtype)], axis=1
+        [bits[:, :255], np.zeros((bits.shape[0], pad), bits.dtype)], axis=1
     )
     return padded.reshape(-1, F.LIMBS, F.BITS).astype(np.int32) @ _LIMB_W
 

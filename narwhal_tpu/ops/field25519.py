@@ -1,20 +1,24 @@
 """GF(2^255 - 19) arithmetic from 32-bit integer lanes, batch-first.
 
-TPU has no native 64-bit multiply, so field elements are 20 limbs of 13
-bits (radix 2^13) held in int32: limb products are ≤ 26 bits and a full
-schoolbook row sum (≤ 20 terms) stays under 2^31 — every intermediate fits
-an int32 lane with no emulated wide arithmetic.  This is the TPU-shaped
+TPU has no native 64-bit multiply, so field elements are 32 limbs of 8
+bits (radix 2^8) held in int32.  The radix is chosen for the MXU: limb
+values ≤ 2^8 round-trip bf16 exactly and their pairwise products (≤ 2^16)
+accumulate exactly in the MXU's f32 accumulators, so the schoolbook
+convolution of a whole batch is ONE dense [B·32², 63] f32 matmul on the
+systolic array — no emulated wide arithmetic anywhere.  Carries, folds and
+comparisons are elementwise int32 on the VPU.  This is the TPU-shaped
 answer to the reference's ed25519-dalek (crypto/src/lib.rs:206-219), whose
-Rust backend uses 51-bit limbs in u128 — a layout that cannot map to VPU
-lanes.
+Rust backend uses 51-bit limbs in u128 — a layout that cannot map to
+vector lanes.
 
-All functions are batch-first: an element is ``int32[..., 20]`` and every
-op vmaps/broadcasts over leading axes.  Limb i holds bits [13i, 13i+13).
-Outputs of mul/add/sub are *weakly reduced* (13-bit limbs, value possibly
-in [p, 2^260)); ``canon`` fully reduces into [0, p).
+All functions are batch-first: an element is ``int32[..., 32]`` and every
+op vmaps/broadcasts over leading axes.  Limb i holds bits [8i, 8i+8).
+Outputs of mul/add/sub are *weakly reduced* (limbs ≤ 2^8, value possibly
+≥ p); ``canon`` fully reduces into [0, p).
 
 Correctness strategy: every op is differential-tested against Python big
-ints over random + boundary values (tests/test_ed25519.py).
+ints over random + boundary values (tests/test_field25519.py), and the
+f32 path's exactness rests on proven magnitude bounds (see mul()).
 """
 
 from __future__ import annotations
@@ -24,13 +28,13 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-BITS = 13
-LIMBS = 20
+BITS = 8
+LIMBS = 32
 MASK = (1 << BITS) - 1
 P = (1 << 255) - 19
 
-# 2^260 ≡ 2^5 · 19 (mod p): folding multiplier for limbs ≥ LIMBS.
-FOLD = 19 << 5  # 608
+# 2^(BITS·LIMBS) = 2^256 ≡ 38 (mod p): folding multiplier for limbs ≥ LIMBS.
+FOLD = 38
 
 
 def to_limbs(x: int) -> np.ndarray:
@@ -47,7 +51,7 @@ def from_limbs(limbs) -> int:
 
 def _carry_once(c: jnp.ndarray) -> jnp.ndarray:
     """One vectorized carry sweep; the carry out of the top limb wraps to
-    limb 0 multiplied by 608 (2^260 ≡ 608 mod p)."""
+    limb 0 multiplied by 38 (2^256 ≡ 38 mod p)."""
     hi = c >> BITS
     lo = c & MASK
     out = lo.at[..., 1:].add(hi[..., :-1])
@@ -55,39 +59,49 @@ def _carry_once(c: jnp.ndarray) -> jnp.ndarray:
 
 
 def carry(c: jnp.ndarray) -> jnp.ndarray:
-    """Propagate carries until every limb is back in [0, 2^13).  Input
-    limbs may be up to 2^31; four sweeps suffice: ≤2^13+2^18 after one,
-    ≤2^13+2^5 after two, ≤2^13+1 after three, <2^13 after four (the ×608
-    wrap feeding limb 0 is absorbed the same way)."""
+    """Propagate carries until every limb is weakly reduced: **< 2^9**
+    (NOT < 2^8 — the final sweep can both leave a limb at 255 + carry-in
+    and add the ×38 top-limb wrap to limb 0, so limb 0 reaches up to
+    255 + 38 = 293).  Input limbs may be up to 2^31; the sweep bounds are
+    ≤ 255 + 2^23, ≤ 255 + 2^15, ≤ 255 + 2^7, then < 2^9.  Every consumer
+    is dimensioned for the 2^9 weak bound (see mul's exactness note and
+    sub's ZP offset)."""
     for _ in range(4):
         c = _carry_once(c)
     return c
 
 
 # c[k] = Σ_{i+j=k} a_i·b_j via a one-hot convolution tensor → one batched
-# int32 matmul the compiler can tile.  ANTI[i·L+j, k] = [i + j == k].
-_ANTI = np.zeros((LIMBS, LIMBS, 2 * LIMBS - 1), dtype=np.int32)
+# f32 matmul on the MXU.  ANTI[i·L+j, k] = [i + j == k].
+_ANTI = np.zeros((LIMBS, LIMBS, 2 * LIMBS - 1), dtype=np.float32)
 for _i in range(LIMBS):
     for _j in range(LIMBS):
-        _ANTI[_i, _j, _i + _j] = 1
+        _ANTI[_i, _j, _i + _j] = 1.0
 _ANTI_FLAT = jnp.asarray(_ANTI.reshape(LIMBS * LIMBS, 2 * LIMBS - 1))
 
 
 def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """Field multiply, weakly reduced output."""
-    outer = (a[..., :, None] * b[..., None, :]).reshape(
+    """Field multiply, weakly reduced output.
+
+    Exactness of the f32 path: weak limbs are < 2^9 (carry()'s bound), so
+    pairwise products are < 2^18 (exact in f32) and a convolution row
+    accumulates ≤ 32 of them → < 2^23, below the 2^24 f32 integer limit —
+    f32 accumulation is exact.  Precision.HIGHEST forces the MXU's
+    exact-f32 multi-pass mode; the default bf16 single pass would round
+    the outer products."""
+    af = a.astype(jnp.float32)
+    bf = b.astype(jnp.float32)
+    outer = (af[..., :, None] * bf[..., None, :]).reshape(
         a.shape[:-1] + (LIMBS * LIMBS,)
     )
-    conv = jnp.matmul(outer, _ANTI_FLAT)  # [..., 39], each ≤ 20·2^26 < 2^31
-    # Fold limbs ≥ 20: 2^(13(20+j)) ≡ 608·2^(13j) (mod p).  Split each high
-    # limb first so the ×608 stays in int32: parts are ≤ 2^13 and ≤ 2^18,
-    # and 608·2^18 < 2^28.
+    conv = jnp.matmul(
+        outer, _ANTI_FLAT, precision=jax.lax.Precision.HIGHEST
+    ).astype(jnp.int32)  # [..., 63]
+    # Fold limbs ≥ 32: 2^(8(32+j)) ≡ 38·2^(8j) (mod p); conv < 2^23 so the
+    # ×38 (< 2^29) stays inside int32.
     hi = conv[..., LIMBS:]
     lo = conv[..., :LIMBS]
-    folded = (
-        lo.at[..., : LIMBS - 1].add((hi & MASK) * FOLD)
-        .at[..., 1:LIMBS].add((hi >> BITS) * FOLD)
-    )
+    folded = lo.at[..., : LIMBS - 1].add(hi * FOLD)
     return carry(folded)
 
 
@@ -100,15 +114,17 @@ def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
 
 
 # Borrow-free subtraction needs a limb vector ZP whose value is ≡ 0 (mod p)
-# with EVERY limb ≥ 2^13 (an upper bound on a weakly-reduced operand's
-# limbs): then (a + ZP - b) is non-negative per limb and carry() reduces it.
-# Construct: put 2·MASK in every limb, then add the canonical limbs of the
-# complement that makes the total a multiple of p.
+# with EVERY limb ≥ 2^9 (the weak bound on an operand's limbs, see carry()):
+# then (a + ZP - b) is non-negative per limb and carry() reduces it.
+# Construct: put 2·MASK = 510 in every limb, then add the canonical limbs of
+# the complement that makes the total a multiple of p — every final limb is
+# ≥ 510 + 0... asserted ≥ 512 below via the 637 minimum that construction
+# actually yields.
 _base = sum(2 * MASK << (BITS * i) for i in range(LIMBS))
 _comp = (-_base) % P
 _zp = [2 * MASK + ((_comp >> (BITS * i)) & MASK) for i in range(LIMBS)]
 assert sum(v << (BITS * i) for i, v in enumerate(_zp)) % P == 0
-assert all((1 << BITS) <= v < (1 << 15) for v in _zp)
+assert all((1 << 9) <= v < (1 << 15) for v in _zp), _zp
 _ZP = jnp.asarray(np.array(_zp, dtype=np.int32))
 
 
@@ -193,9 +209,9 @@ def canon(a: jnp.ndarray) -> jnp.ndarray:
     # sweeps so any spike exits the top and wraps to a small limb-0 term.
     for _ in range(LIMBS + 2):
         c = _carry_once(c)
-    # Value is now < 2^260 ≈ 32·p: strip multiples of p by conditional
-    # subtraction until below p.
-    for _ in range(33):
+    # Value is now < 2^256 < 3p: strip multiples of p by conditional
+    # subtraction until below p (3 rounds give margin).
+    for _ in range(3):
         d, under = _sub_p(c)
         c = jnp.where(under[..., None], c, d)
     return c

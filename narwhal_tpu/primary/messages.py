@@ -61,12 +61,22 @@ class Header:
             w.raw(parent)
         return digest32(w.finish())
 
-    def verify(self, committee: Committee) -> None:
-        """Reference messages.rs:48-67."""
+    def verify_structure(self, committee: Committee) -> None:
+        """All non-crypto checks of verify() (reference messages.rs:48-63)."""
         if self.id != self.compute_digest():
             raise InvalidHeaderId(f"header {self.id!r} id mismatch")
         if committee.stake(self.author) <= 0:
             raise UnknownAuthority(repr(self.author))
+
+    def signature_claims(self) -> List[Tuple[bytes, PublicKey, Signature]]:
+        """(message, key, signature) triples this message's validity rests
+        on — the unit the Core accumulates into one batched device verify
+        (SURVEY.md §7 'accumulate → batch-verify → replay')."""
+        return [(bytes(self.id), self.author, self.signature)]
+
+    def verify(self, committee: Committee) -> None:
+        """Reference messages.rs:48-67."""
+        self.verify_structure(committee)
         if not verify(bytes(self.id), self.author, self.signature):
             raise InvalidSignature(f"header {self.id!r}")
 
@@ -130,9 +140,15 @@ class Vote:
         w.raw(self.origin)
         return digest32(w.finish())
 
-    def verify(self, committee: Committee) -> None:
+    def verify_structure(self, committee: Committee) -> None:
         if committee.stake(self.author) <= 0:
             raise UnknownAuthority(repr(self.author))
+
+    def signature_claims(self) -> List[Tuple[bytes, PublicKey, Signature]]:
+        return [(bytes(self.digest()), self.author, self.signature)]
+
+    def verify(self, committee: Committee) -> None:
+        self.verify_structure(committee)
         if not verify(bytes(self.digest()), self.author, self.signature):
             raise InvalidSignature(f"vote by {self.author!r}")
 
@@ -180,13 +196,12 @@ class Certificate:
         w.raw(self.origin)
         return digest32(w.finish())
 
-    def verify(self, committee: Committee) -> None:
-        """Quorum + batched signature check (reference messages.rs:189-215).
-        The batched call is the #1 crypto hot loop — the TPU backend verifies
-        all 2f+1 signatures in one device dispatch."""
+    def verify_structure(self, committee: Committee) -> None:
+        """Quorum + reuse + authority checks (reference messages.rs:189-213,
+        everything before the batched signature verification)."""
         if self in genesis(committee):
             return
-        self.header.verify(committee)
+        self.header.verify_structure(committee)
         weight = 0
         used = set()
         for name, _ in self.votes:
@@ -199,6 +214,25 @@ class Certificate:
             weight += stake
         if weight < committee.quorum_threshold():
             raise CertificateRequiresQuorum(repr(self.digest()))
+
+    def signature_claims(self) -> List[Tuple[bytes, PublicKey, Signature]]:
+        """Header signature + every vote signature over this certificate's
+        digest — 2f+2 claims joining the Core's accumulated device batch."""
+        if not self.votes:  # genesis
+            return []
+        d = bytes(self.digest())
+        return self.header.signature_claims() + [
+            (d, name, sig) for name, sig in self.votes
+        ]
+
+    def verify(self, committee: Committee) -> None:
+        """Quorum + batched signature check (reference messages.rs:189-215).
+        The batched call is the #1 crypto hot loop — the TPU backend verifies
+        all 2f+1 signatures in one device dispatch."""
+        if self in genesis(committee):
+            return
+        self.verify_structure(committee)
+        self.header.verify(committee)
         if not verify_batch(
             self.digest(), [n for n, _ in self.votes], [s for _, s in self.votes]
         ):
