@@ -4,7 +4,7 @@ The reference's per-round crypto hot loop is `Signature::verify_batch`
 (crypto/src/lib.rs:206-219), called with 2f+1 signatures per certificate ×
 N certificates per round (primary/src/messages.rs:189-215).  Its dalek
 backend runs 51-bit-limb u128 arithmetic on the CPU; here the same batch
-maps to TPU vector lanes: field elements are 20×13-bit int32 limbs
+maps to TPU vector lanes: field elements are 32×8-bit int32 limbs
 (ops/field25519.py), points are extended twisted-Edwards coordinates
 (X:Y:Z:T), and the double-scalar ladder [s]B + [k](-A) runs one shared
 MSB-first windowed Horner loop for the whole batch.

@@ -202,11 +202,12 @@ def _sub_p(c: jnp.ndarray):
 
 
 def canon(a: jnp.ndarray) -> jnp.ndarray:
-    """Fully reduce into [0, p) with strictly canonical limbs (< 2^13)."""
+    """Fully reduce into [0, p) with strictly canonical limbs (< 2^8)."""
     c = carry(a)
-    # carry() can leave a limb at exactly 2^13 (carry-in onto a full limb),
-    # and one sweep only moves such a spike up one position — run LIMBS+2
-    # sweeps so any spike exits the top and wraps to a small limb-0 term.
+    # carry() only guarantees the weak bound (limbs < 2^9, i.e. up to one
+    # carry bit above a full 2^8-1 limb), and one sweep only moves such a
+    # spike up one position — run LIMBS+2 sweeps so any spike exits the
+    # top and wraps to a small limb-0 term, leaving every limb < 2^8.
     for _ in range(LIMBS + 2):
         c = _carry_once(c)
     # Value is now < 2^256 < 3p: strip multiples of p by conditional

@@ -35,6 +35,7 @@ class Store:
         self._map: Dict[bytes, bytes] = {}
         self._obligations: Dict[bytes, List[asyncio.Future]] = {}
         self._fd: Optional[int] = None
+        self._size = 0  # valid log length (single writer: we own the file)
         if path is not None:
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
             if os.path.exists(path):
@@ -61,13 +62,31 @@ class Store:
             # (it stops at the first torn record).
             with open(path, "r+b") as f:
                 f.truncate(pos)
+        self._size = pos
 
     def write(self, key: bytes, value: bytes) -> None:
         self._map[key] = value
         if self._fd is not None:
             # One writev() per record: no serialization copy, atomic w.r.t.
             # our own replay logic (torn tails are discarded on open).
-            os.writev(self._fd, [_REC.pack(len(key), len(value)), key, value])
+            # writev may write short (signal, ENOSPC cleared later): retry
+            # the remainder, else the torn record would make every later
+            # append unrecoverable on replay (truncation stops at it).
+            bufs = [_REC.pack(len(key), len(value)), key, value]
+            total = sum(len(b) for b in bufs)
+            try:
+                written = os.writev(self._fd, bufs)
+                if written < total:
+                    flat = b"".join(bufs)
+                    while written < total:
+                        written += os.write(self._fd, flat[written:])
+            except OSError:
+                # A torn record would strand every later append behind it on
+                # replay (truncation stops at the first torn record): roll
+                # the file back to the record boundary before propagating.
+                os.ftruncate(self._fd, self._size)
+                raise
+            self._size += total
         # Wake every parked notify_read on this key.
         waiters = self._obligations.pop(key, None)
         if waiters:
