@@ -57,6 +57,44 @@ def main() -> None:
         )
     ranked = sorted(results, key=lambda r: r.end_to_end_tps)
     result = ranked[len(ranked) // 2]
+
+    # North-star microbenchmark (BASELINE.json): ed25519 verifies/sec/chip
+    # on the real device, captured in the same driver artifact.  Runs in a
+    # subprocess so the bench processes' environment stays untouched;
+    # non-fatal (the e2e number above is reported either way).
+    crypto: dict = {}
+    if os.environ.get("BENCH_CRYPTO", "1") == "1":
+        import subprocess
+
+        try:
+            out = subprocess.run(
+                [
+                    sys.executable,
+                    os.path.join(REPO, "bench_crypto.py"),
+                    "--batches",
+                    "8192",
+                    "--iters",
+                    "3",
+                    "--cpu-budget",
+                    "0.5",
+                ],
+                capture_output=True,
+                text=True,
+                timeout=540,
+            )
+            last = [
+                ln
+                for ln in out.stdout.splitlines()
+                if ln.startswith("{") and "ed25519" in ln
+            ]
+            if last:
+                cr = json.loads(last[-1])
+                crypto = {
+                    "ed25519_verifies_per_sec_chip": cr["value"],
+                    "ed25519_vs_cpu_core": cr["vs_baseline"],
+                }
+        except Exception:
+            pass
     if result.end_to_end_tps > 0:
         metric, tps, baseline = (
             "end_to_end_tps_local_4n",
@@ -81,6 +119,7 @@ def main() -> None:
                 "runs_e2e_tps": [round(r.end_to_end_tps, 1) for r in results],
                 "consensus_latency_ms": round(result.consensus_latency_ms, 1),
                 "end_to_end_latency_ms": round(result.end_to_end_latency_ms, 1),
+                **crypto,
             }
         )
     )

@@ -1,0 +1,172 @@
+#!/usr/bin/env python3
+"""North-star microbenchmark: ed25519 signature verifications/sec/chip.
+
+BASELINE.json names `ed25519 verifies/sec/chip` as this build's own metric.
+This benchmark measures the TPU batch verifier (narwhal_tpu/ops/ed25519.py,
+the device analog of the reference's dalek `verify_batch`,
+/root/reference/crypto/src/lib.rs:206-219) against the CPU/OpenSSL verifier
+on the same host, at batch sizes spanning the protocol's realistic range
+(a 4-node certificate carries 3 sigs; a 50-node round can burst ~8k sigs
+through the Core's accumulate→batch-verify seam).
+
+Methodology:
+- steady state only: first call per shape compiles (tens of seconds, then
+  cached persistently via NARWHAL_JAX_CACHE); timings start after a warmup
+  call per shape.
+- `device`: median-of-N wall time of dispatch→block on the result mask —
+  the latency a Core burst actually pays.
+- `pipelined`: K batches dispatched back-to-back before blocking — the
+  sustained chip rate when host prep overlaps device compute (the async
+  verify path in primary/core.py works this way).
+- `prep`: host-side bytes→limbs/windows + SHA-512 hash-to-scalar cost.
+- CPU baseline: single-core OpenSSL verify loop (this host has 1 core;
+  multiply by core count for a multi-core host figure).
+
+Output: one JSON line per configuration plus a `summary` line; pass
+`--artifact PATH` to also write the full result set to a JSON file.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+
+
+def make_batch(n: int, seed: int = 7):
+    """n valid (message, key, signature) triples over 32-byte messages."""
+    import hashlib
+
+    from narwhal_tpu.crypto import KeyPair
+    from narwhal_tpu.crypto.keys import cpu_verify
+
+    from narwhal_tpu.crypto.digest import Digest
+
+    kp = KeyPair.generate(rng_seed=hashlib.sha256(b"bench%d" % seed).digest())
+    msgs = [hashlib.sha256(i.to_bytes(8, "little")).digest() for i in range(n)]
+    # KeyPair.sign signs a Digest (32 bytes) — exactly the protocol's usage.
+    sigs = [kp.sign(Digest(m)) for m in msgs]
+    assert cpu_verify(msgs[0], kp.name, sigs[0])
+    return msgs, [kp.name] * n, sigs
+
+
+def bench_cpu(msgs, keys, sigs, budget_s: float = 2.0) -> float:
+    """Single-core OpenSSL verifies/sec."""
+    from narwhal_tpu.crypto.keys import cpu_verify
+
+    n, i, t0 = 0, 0, time.perf_counter()
+    while time.perf_counter() - t0 < budget_s:
+        assert cpu_verify(msgs[i], keys[i], sigs[i])
+        i = (i + 1) % len(msgs)
+        n += 1
+    return n / (time.perf_counter() - t0)
+
+
+def bench_tpu(msgs, keys, sigs, batch: int, iters: int, pipeline_depth: int = 4):
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from narwhal_tpu.ops import ed25519 as E
+
+    m, k, s = msgs[:batch], keys[:batch], sigs[:batch]
+
+    # Host prep cost (amortized per signature).
+    t0 = time.perf_counter()
+    args = E.prepare_batch(m, k, s, batch)
+    prep_s = time.perf_counter() - t0
+    jargs = [jnp.asarray(a) for a in args]
+
+    # Warmup / compile (persistent cache makes this fast on reruns).
+    t0 = time.perf_counter()
+    mask = np.asarray(E._verify_kernel(*jargs))
+    compile_s = time.perf_counter() - t0
+    if not mask.all():
+        raise AssertionError("kernel rejected valid signatures")
+
+    # Blocking latency per batch.
+    lat = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        np.asarray(E._verify_kernel(*jargs))
+        lat.append(time.perf_counter() - t0)
+    lat_s = statistics.median(lat)
+
+    # Pipelined: dispatch K batches, block once at the end.
+    t0 = time.perf_counter()
+    outs = [E._verify_kernel(*jargs) for _ in range(pipeline_depth)]
+    for o in outs:
+        o.block_until_ready()
+    pipe_s = (time.perf_counter() - t0) / pipeline_depth
+
+    return {
+        "batch": batch,
+        "prep_us_per_sig": round(1e6 * prep_s / batch, 2),
+        "compile_or_cache_load_s": round(compile_s, 2),
+        "device_ms_per_batch": round(1e3 * lat_s, 2),
+        "device_verifies_per_s": round(batch / lat_s, 1),
+        "pipelined_verifies_per_s": round(batch / pipe_s, 1),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--batches", type=int, nargs="+", default=[128, 512, 2048, 8192]
+    )
+    ap.add_argument("--iters", type=int, default=5)
+    ap.add_argument("--cpu-budget", type=float, default=2.0)
+    ap.add_argument("--artifact", type=str, default=None)
+    args = ap.parse_args()
+
+    msgs, keys, sigs = make_batch(max(args.batches))
+
+    cpu_vps = bench_cpu(msgs, keys, sigs, args.cpu_budget)
+    results = {
+        "metric": "ed25519_verifies_per_sec_chip",
+        "cpu_openssl_verifies_per_s_core": round(cpu_vps, 1),
+        "host_cores": os.cpu_count(),
+        "tpu": [],
+    }
+    import jax
+
+    results["device"] = str(jax.devices()[0])
+    for b in args.batches:
+        r = bench_tpu(msgs, keys, sigs, b, args.iters)
+        results["tpu"].append(r)
+        print(json.dumps(r))
+
+    best = max(results["tpu"], key=lambda r: r["pipelined_verifies_per_s"])
+    results["best_verifies_per_s_chip"] = best["pipelined_verifies_per_s"]
+    results["best_batch"] = best["batch"]
+    results["vs_cpu_core"] = round(
+        best["pipelined_verifies_per_s"] / cpu_vps, 2
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "ed25519_verifies_per_sec_chip",
+                "value": results["best_verifies_per_s_chip"],
+                "unit": "verifies/s",
+                "vs_baseline": results["vs_cpu_core"],
+                "cpu_core_verifies_per_s": results[
+                    "cpu_openssl_verifies_per_s_core"
+                ],
+                "batch": best["batch"],
+                "device": results["device"],
+            }
+        )
+    )
+    if args.artifact:
+        with open(args.artifact, "w") as f:
+            json.dump(results, f, indent=2)
+
+
+if __name__ == "__main__":
+    main()

@@ -20,8 +20,9 @@ deviations from specific CPU libraries are *more* rejections, never fewer):
   only canonical encodings are admitted).
 
 SHA-512(R‖A‖M) and the scalar window decomposition run host-side during
-batch prep (~1 µs/signature, amortized); every field/curve operation runs
-on device.  Differential-tested against OpenSSL over random and
+batch prep (measured ~8-10 µs/signature on a 1-core host, overlappable
+with device compute; see bench_crypto.py); every field/curve operation
+runs on device.  Differential-tested against OpenSSL over random and
 adversarial inputs (tests/test_ed25519.py).
 """
 
@@ -254,7 +255,7 @@ def _verify_kernel(
 # ----------------------------------------------------------- host-side prep
 #
 # Fully vectorized with numpy (the kernel's feed must not become a Python
-# loop): bytes → bit matrix → 13-bit limbs / 4-bit windows via one matmul
+# loop): bytes → bit matrix → 8-bit limbs / 4-bit windows via one matmul
 # each.  Only SHA-512 (hashlib, C speed) and the 512→mod-L reduction touch
 # Python objects per signature.
 
