@@ -1,0 +1,135 @@
+#!/usr/bin/env python3
+"""KernelTusk microbenchmark: device leader-chain scan vs golden Python walk.
+
+The reference's commit rule does one `linked()` BFS per earlier leader per
+commit attempt (consensus/src/lib.rs:224-259); KernelTusk collapses the
+whole chain into one jitted scan (narwhal_tpu/ops/reachability.py).  This
+measures `order_leaders` wall time for both implementations over identical
+DAG state at committee sizes N ∈ {4, 20, 50} and a gc_depth-50 window —
+the "large-DAG scaling" duty from SURVEY.md §5.
+
+Methodology: build `span` rounds of a full DAG (every authority, full
+parent links — the densest, worst case), call order_leaders on the newest
+anchor leader T times, report the median per-call time.  The kernel path
+is prewarmed first (one static shape; persistent compile cache applies).
+
+    python bench_consensus.py --sizes 4 20 50 --span 48 --iters 5 \
+        --artifact artifacts/consensus_bench.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+
+from narwhal_tpu.config import (  # noqa: E402
+    Authority,
+    Committee,
+    PrimaryAddresses,
+    WorkerAddresses,
+)
+from narwhal_tpu.crypto import KeyPair  # noqa: E402
+from narwhal_tpu.consensus.tusk import Tusk  # noqa: E402
+from narwhal_tpu.primary.messages import Certificate, Header, genesis  # noqa: E402
+
+
+def make_committee(n: int) -> Committee:
+    auths = {}
+    for i in range(n):
+        kp = KeyPair.generate(rng_seed=i.to_bytes(32, "little"))
+        auths[kp.name] = Authority(
+            stake=1,
+            primary=PrimaryAddresses("127.0.0.1:0", "127.0.0.1:0"),
+            workers={0: WorkerAddresses("127.0.0.1:0", "127.0.0.1:0", "127.0.0.1:0")},
+        )
+    return Committee(auths)
+
+
+def mock_certificate(origin, round_, parents) -> Certificate:
+    header = Header(
+        author=origin, round=round_, payload={}, parents=set(parents)
+    )
+    return Certificate(header=header, votes=[])
+
+
+def build_state(tusk: Tusk, committee: Committee, span: int):
+    """Fill the DAG with `span` full rounds WITHOUT committing (leaders are
+    inserted but process_certificate is bypassed), then return the anchor
+    leader certificate for order_leaders."""
+    names = sorted(committee.authorities.keys())
+    parents = {c.digest() for c in genesis(committee)}
+    state = tusk.state
+    anchor = None
+    for r in range(1, span + 1):
+        nxt = set()
+        for name in names:
+            cert = mock_certificate(name, r, parents)
+            state.dag.setdefault(r, {})[name] = (cert.digest(), cert)
+            nxt.add(cert.digest())
+        parents = nxt
+    # Anchor: leader of the last even round.
+    anchor_round = span if span % 2 == 0 else span - 1
+    leader_name = tusk._sorted_keys[0 if tusk.fixed_coin else anchor_round % len(names)]
+    anchor = state.dag[anchor_round][leader_name][1]
+    return anchor
+
+
+def bench_one(cls, committee, span, iters, prewarm=False):
+    tusk = cls(committee, gc_depth=50, fixed_coin=True)
+    if prewarm and hasattr(tusk, "prewarm"):
+        tusk.prewarm()
+    anchor = build_state(tusk, committee, span)
+    times = []
+    chain_len = None
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        chain = tusk.order_leaders(anchor)
+        times.append(time.perf_counter() - t0)
+        chain_len = len(chain)
+    return statistics.median(times), chain_len
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sizes", type=int, nargs="+", default=[4, 20, 50])
+    ap.add_argument("--span", type=int, default=48)
+    ap.add_argument("--iters", type=int, default=5)
+    ap.add_argument("--artifact", type=str, default=None)
+    args = ap.parse_args()
+
+    from narwhal_tpu.ops.reachability import KernelTusk
+
+    results = []
+    for n in args.sizes:
+        committee = make_committee(n)
+        py_t, py_chain = bench_one(Tusk, committee, args.span, args.iters)
+        k_t, k_chain = bench_one(
+            KernelTusk, committee, args.span, args.iters, prewarm=True
+        )
+        assert py_chain == k_chain, (py_chain, k_chain)
+        row = {
+            "committee": n,
+            "span_rounds": args.span,
+            "leaders_in_chain": py_chain,
+            "python_ms": round(py_t * 1e3, 2),
+            "kernel_ms": round(k_t * 1e3, 2),
+            "speedup": round(py_t / k_t, 2),
+        }
+        results.append(row)
+        print(json.dumps(row))
+
+    if args.artifact:
+        os.makedirs(os.path.dirname(args.artifact) or ".", exist_ok=True)
+        with open(args.artifact, "w") as f:
+            json.dump(results, f, indent=2)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,136 @@
+"""Multi-run aggregation and latency-vs-throughput sweeps.
+
+The reference's aggregation subsystem (benchmark/benchmark/aggregate.py +
+plot.py, ~430 LoC) averages repeated runs (mean/stdev per metric) and plots
+latency-vs-throughput curves over input-rate sweeps.  This is the local
+analog: run the bench at each rate N times, aggregate, and emit a summary
+table plus a JSON artifact the plots can be drawn from.
+
+    python benchmark/aggregate.py --rates 20000 40000 55000 --runs 2 \
+        --duration 20 --out artifacts/sweep.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+from typing import Dict, List
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from benchmark.local_bench import run_bench  # noqa: E402
+
+METRICS = [
+    "consensus_tps",
+    "consensus_latency_ms",
+    "end_to_end_tps",
+    "end_to_end_latency_ms",
+]
+
+
+def aggregate(results: List) -> Dict[str, Dict[str, float]]:
+    """Mean/stdev per metric across repeated runs of one configuration
+    (reference aggregate.py `Setup`/`Result.aggregate`)."""
+    out: Dict[str, Dict[str, float]] = {}
+    for m in METRICS:
+        vals = [getattr(r, m) for r in results]
+        out[m] = {
+            "mean": round(statistics.mean(vals), 1),
+            "stdev": round(statistics.stdev(vals), 1) if len(vals) > 1 else 0.0,
+            "runs": [round(v, 1) for v in vals],
+        }
+    return out
+
+
+def sweep(
+    rates: List[int],
+    runs: int,
+    **bench_kwargs,
+) -> List[Dict]:
+    """Latency-vs-throughput curve: one aggregated point per input rate."""
+    points = []
+    for rate in rates:
+        results = [
+            run_bench(rate=rate, quiet=True, **bench_kwargs)
+            for _ in range(runs)
+        ]
+        errors = [e for r in results for e in r.errors]
+        point = {"rate": rate, **aggregate(results)}
+        if errors:
+            point["errors"] = errors[:5]
+        points.append(point)
+        print(json.dumps(point))
+    return points
+
+
+def table(points: List[Dict]) -> str:
+    """Human-readable latency-vs-throughput table (the plot's data)."""
+    lines = [
+        f"{'rate':>8} | {'e2e tps':>9} ± {'sd':>6} | {'e2e lat ms':>10} | "
+        f"{'cons tps':>9} | {'cons lat ms':>11}",
+        "-" * 64,
+    ]
+    for p in points:
+        lines.append(
+            f"{p['rate']:>8,} | {p['end_to_end_tps']['mean']:>9,.0f} ± "
+            f"{p['end_to_end_tps']['stdev']:>6,.0f} | "
+            f"{p['end_to_end_latency_ms']['mean']:>10,.0f} | "
+            f"{p['consensus_tps']['mean']:>9,.0f} | "
+            f"{p['consensus_latency_ms']['mean']:>11,.0f}"
+        )
+    return "\n".join(lines)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rates", type=int, nargs="+", required=True)
+    ap.add_argument("--runs", type=int, default=2)
+    ap.add_argument("--nodes", type=int, default=4)
+    ap.add_argument("--workers", type=int, default=1)
+    ap.add_argument("--duration", type=int, default=20)
+    ap.add_argument("--tx-size", type=int, default=512)
+    ap.add_argument("--faults", type=int, default=0)
+    ap.add_argument("--batch-size", type=int, default=125_000)
+    ap.add_argument("--base-port", type=int, default=7800)
+    ap.add_argument("--out", type=str, default=None)
+    args = ap.parse_args()
+
+    points = sweep(
+        args.rates,
+        args.runs,
+        nodes=args.nodes,
+        workers=args.workers,
+        duration=args.duration,
+        tx_size=args.tx_size,
+        faults=args.faults,
+        batch_size=args.batch_size,
+        base_port=args.base_port,
+    )
+    print(table(points))
+    if args.out:
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(
+                {
+                    "config": {
+                        "nodes": args.nodes,
+                        "workers": args.workers,
+                        "faults": args.faults,
+                        "tx_size": args.tx_size,
+                        "duration": args.duration,
+                        "runs_per_rate": args.runs,
+                        "batch_size": args.batch_size,
+                    },
+                    "points": points,
+                },
+                f,
+                indent=2,
+            )
+
+
+if __name__ == "__main__":
+    main()

@@ -91,6 +91,8 @@ def run_bench(
     workdir: str = None,
     keep_logs: bool = False,
     quiet: bool = False,
+    crypto_backend: str = None,
+    consensus_kernel: bool = False,
 ):
     kill_stale_nodes()
     workdir = workdir or os.path.join(REPO, ".bench")
@@ -120,7 +122,14 @@ def run_bench(
     for i, kp in enumerate(keypairs):
         export_keypair(kp, f"{workdir}/node-{i}.json")
 
-    env = dict(os.environ, PYTHONPATH=REPO)
+    # Prepend (not overwrite) PYTHONPATH: the host environment may inject
+    # interpreter-startup hooks through it (e.g. the TPU platform plugin
+    # registers via a sitecustomize on PYTHONPATH — dropping it leaves
+    # JAX_PLATFORMS pointing at a platform that never loads).
+    pythonpath = os.pathsep.join(
+        p for p in [REPO, os.environ.get("PYTHONPATH", "")] if p
+    )
+    env = dict(os.environ, PYTHONPATH=pythonpath)
     procs = []
     primary_logs, worker_logs, client_logs = [], [], []
 
@@ -131,6 +140,12 @@ def run_bench(
         )
         procs.append((p, f))
         return p
+
+    node_flags = []
+    if crypto_backend:
+        node_flags += ["--crypto-backend", crypto_backend]
+    if consensus_kernel:
+        node_flags += ["--consensus-kernel"]
 
     alive = nodes - faults  # crash faults: the last `faults` nodes never boot
     for i in range(alive):
@@ -151,6 +166,7 @@ def run_bench(
                 "--store",
                 f"{storedir}/db-primary-{i}",
                 "--benchmark",
+                *node_flags,
                 "primary",
             ],
             log,
@@ -179,6 +195,24 @@ def run_bench(
                 ],
                 log,
             )
+
+    # TPU-backed nodes spend tens of seconds warming the XLA kernels at
+    # boot; don't start the measured load until every primary reports
+    # booted, or the warmup eats the run window.
+    if crypto_backend == "tpu" or consensus_kernel:
+        deadline = time.time() + 600
+        pending = set(primary_logs)
+        while pending and time.time() < deadline:
+            for p in list(pending):
+                try:
+                    if "successfully booted" in open(p).read():
+                        pending.discard(p)
+                except OSError:
+                    pass
+            if pending:
+                time.sleep(2)
+        if pending and not quiet:
+            print(f"WARNING: primaries never booted: {pending}", file=sys.stderr)
 
     # One client per live worker, rate split evenly (reference local.py:78).
     committee_obj = committee
@@ -252,6 +286,8 @@ def main():
     parser.add_argument("--faults", type=int, default=0)
     parser.add_argument("--base-port", type=int, default=7000)
     parser.add_argument("--json", action="store_true")
+    parser.add_argument("--crypto-backend", choices=["cpu", "tpu"], default=None)
+    parser.add_argument("--consensus-kernel", action="store_true")
     args = parser.parse_args()
 
     result = run_bench(
@@ -262,6 +298,8 @@ def main():
         duration=args.duration,
         faults=args.faults,
         base_port=args.base_port,
+        crypto_backend=args.crypto_backend,
+        consensus_kernel=args.consensus_kernel,
     )
     if result.errors:
         print("ERRORS detected in logs:", file=sys.stderr)

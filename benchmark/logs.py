@@ -1,14 +1,25 @@
 """Log parser: turn node/client logs into TPS and latency numbers.
 
-Reference benchmark/benchmark/logs.py (259 LoC) — the measurement system:
-- consensus TPS   = committed batch bytes / (first batch creation → last
-                    commit) / tx size
-- consensus latency = commit time − batch creation time, averaged
-- end-to-end latency = sample-tx client-send → commit of its batch
+Faithful to the reference measurement system (benchmark/benchmark/logs.py,
+259 LoC) so numbers are directly comparable with BASELINE.md:
+
+- proposals  = `Created B{round}({header}) -> {digest}` lines from primary
+  logs, earliest timestamp per digest across nodes (logs.py:101-103,70-77)
+- commits    = `Committed B{round}({header}) -> {digest}` lines, earliest
+  per digest (logs.py:105-107)
+- consensus TPS = committed batch bytes / (first proposal → last commit)
+  (logs.py:155-163); consensus latency = mean(commit − proposal) per
+  committed digest (logs.py:165-167)
+- end-to-end TPS = committed batch bytes / (first client start → last
+  commit) (logs.py:179-186); end-to-end latency = sample-tx client-send →
+  commit of its containing batch (logs.py:188-198)
+- config echo-back: every primary must echo the full parameter set at boot
+  and all echoes must agree (logs.py:109-131)
 - hard-fails if any log contains an error marker (logs.py:98,138)
 
 Log lines joined (emitted by this framework under --benchmark):
-  client:    Sending sample transaction {id}
+  client:    Start sending transactions / Transactions size|rate /
+             Sending sample transaction {id} / rate too high
   worker:    Batch {digest} contains sample tx {id}
              Batch {digest} contains {n} B
   primary:   Created B{round}({header}) -> {batch_digest}
@@ -23,6 +34,18 @@ from datetime import datetime
 from typing import Dict, List
 
 _TS = r"(\d{4}-\d{2}-\d{2}T\d{2}:\d{2}:\d{2}\.\d{3})Z"
+
+# Parameters.log echo lines (narwhal_tpu/config.py, reference
+# config/src/lib.rs:100-110) parsed back as a consistency check.
+_CONFIG_PATTERNS = [
+    ("header_size", r"Header size set to (\d+) B"),
+    ("max_header_delay", r"Max header delay set to (\d+) ms"),
+    ("gc_depth", r"Garbage collection depth set to (\d+) rounds"),
+    ("sync_retry_delay", r"Sync retry delay set to (\d+) ms"),
+    ("sync_retry_nodes", r"Sync retry nodes set to (\d+) nodes"),
+    ("batch_size", r"Batch size set to (\d+) B"),
+    ("max_batch_delay", r"Max batch delay set to (\d+) ms"),
+]
 
 
 def _ts(s: str) -> float:
@@ -45,6 +68,8 @@ class ParseResult:
     committed_batches: int = 0
     duration_s: float = 0.0
     samples: int = 0
+    rate_misses: int = 0
+    config: Dict[str, int] = field(default_factory=dict)
     errors: List[str] = field(default_factory=list)
 
     def summary(self, rate: int, tx_size: int, nodes: int, workers: int) -> str:
@@ -70,6 +95,11 @@ class ParseResult:
         )
 
 
+def _merge_earliest(dst: Dict[str, float], key: str, t: float) -> None:
+    if key not in dst or t < dst[key]:
+        dst[key] = t
+
+
 def parse_logs(
     client_logs: List[str],
     worker_logs: List[str],
@@ -87,49 +117,82 @@ def parse_logs(
                 )
                 result.errors.append(line)
 
-    # Client: sample send times.
+    # Clients: start times, sample send times, missed-rate warnings.
+    client_starts: List[float] = []
     sample_sent: Dict[int, float] = {}
     for text in client_logs:
+        m = re.search(_TS + r".* Start sending transactions", text)
+        if m:
+            client_starts.append(_ts(m.group(1)))
+        result.rate_misses += len(re.findall(r"rate too high", text))
         for m in re.finditer(_TS + r".* Sending sample transaction (\d+)", text):
             sample_sent.setdefault(int(m.group(2)), _ts(m.group(1)))
 
-    # Workers: batch creation time, size, contained samples.
-    batch_created: Dict[str, float] = {}
+    # Workers: batch sizes and contained samples.
     batch_bytes: Dict[str, int] = {}
     batch_samples: Dict[str, List[int]] = {}
     for text in worker_logs:
         for m in re.finditer(_TS + r".* Batch (\S+) contains (\d+) B", text):
-            digest = m.group(2)
-            batch_created.setdefault(digest, _ts(m.group(1)))
-            batch_bytes.setdefault(digest, int(m.group(3)))
+            batch_bytes.setdefault(m.group(2), int(m.group(3)))
         for m in re.finditer(_TS + r".* Batch (\S+) contains sample tx (\d+)", text):
             batch_samples.setdefault(m.group(2), []).append(int(m.group(3)))
 
-    # Primaries: commit times (first node to commit wins the timestamp).
+    # Primaries: proposal (Created) and commit times, earliest across nodes.
+    batch_proposed: Dict[str, float] = {}
     batch_committed: Dict[str, float] = {}
     for text in primary_logs:
+        for m in re.finditer(_TS + r".* Created B\d+\(\S+\) -> (\S+)", text):
+            _merge_earliest(batch_proposed, m.group(2), _ts(m.group(1)))
         for m in re.finditer(_TS + r".* Committed B\d+\(\S+\) -> (\S+)", text):
-            t = _ts(m.group(1))
-            d = m.group(2)
-            if d not in batch_committed or t < batch_committed[d]:
-                batch_committed[d] = t
+            _merge_earliest(batch_committed, m.group(2), _ts(m.group(1)))
 
-    committed = [d for d in batch_committed if d in batch_created]
+    # Config echo-back verification (reference logs.py:109-131): every
+    # primary log must carry the full parameter echo and all must agree.
+    configs: List[Dict[str, int]] = []
+    for text in primary_logs:
+        cfg = {}
+        for key, pat in _CONFIG_PATTERNS:
+            m = re.search(pat, text)
+            if m:
+                cfg[key] = int(m.group(1))
+        configs.append(cfg)
+    if configs:
+        complete = [c for c in configs if len(c) == len(_CONFIG_PATTERNS)]
+        if len(complete) != len(configs):
+            result.errors.append("config echo missing from primary log(s)")
+        elif any(c != configs[0] for c in configs):
+            result.errors.append("config echo differs between primaries")
+        else:
+            result.config = configs[0]
+
+    committed = list(batch_committed)
     if not committed:
         return result
 
     result.committed_batches = len(committed)
     result.committed_bytes = sum(batch_bytes.get(d, 0) for d in committed)
-    start = min(batch_created[d] for d in committed)
-    end = max(batch_committed[d] for d in committed)
-    duration = max(end - start, 1e-6)
-    result.duration_s = duration
-    result.consensus_bps = result.committed_bytes / duration
-    result.consensus_tps = result.consensus_bps / tx_size
-    lats = [batch_committed[d] - batch_created[d] for d in committed]
-    result.consensus_latency_ms = 1000 * sum(lats) / len(lats)
 
-    # End-to-end: join sample send → containing batch → commit.
+    # Consensus: first proposal → last commit (reference logs.py:155-167).
+    with_proposal = [d for d in committed if d in batch_proposed]
+    if len(with_proposal) != len(committed):
+        result.errors.append(
+            f"{len(committed) - len(with_proposal)} committed digest(s) "
+            "have no Created line in any primary log"
+        )
+    if with_proposal:
+        start = min(batch_proposed[d] for d in with_proposal)
+        end = max(batch_committed[d] for d in with_proposal)
+        duration = max(end - start, 1e-6)
+        result.duration_s = duration
+        result.consensus_bps = result.committed_bytes / duration
+        result.consensus_tps = result.consensus_bps / tx_size
+        lats = [
+            batch_committed[d] - batch_proposed[d] for d in with_proposal
+        ]
+        result.consensus_latency_ms = 1000 * sum(lats) / len(lats)
+
+    # End-to-end: client start → last commit; latency joins sample send →
+    # containing batch → commit (reference logs.py:179-198).
     e2e = []
     for digest in committed:
         for sample_id in batch_samples.get(digest, []):
@@ -137,9 +200,12 @@ def parse_logs(
             if sent is not None:
                 e2e.append(batch_committed[digest] - sent)
     result.samples = len(e2e)
-    if e2e and sample_sent:
-        first_send = min(sample_sent.values())
-        e2e_duration = max(end - first_send, 1e-6)
+    starts = client_starts or (
+        [min(sample_sent.values())] if sample_sent else []
+    )
+    if e2e and starts:
+        end = max(batch_committed[d] for d in committed)
+        e2e_duration = max(end - min(starts), 1e-6)
         result.end_to_end_bps = result.committed_bytes / e2e_duration
         result.end_to_end_tps = result.end_to_end_bps / tx_size
         result.end_to_end_latency_ms = 1000 * sum(e2e) / len(e2e)

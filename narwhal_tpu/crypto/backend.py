@@ -29,6 +29,17 @@ class CpuBackend:
     ) -> List[bool]:
         return [cpu_verify(m, k, s) for m, k, s in zip(messages, keys, sigs)]
 
+    async def averify_batch_mask(
+        self,
+        messages: Sequence[bytes],
+        keys: Sequence[PublicKey],
+        sigs: Sequence[Signature],
+    ) -> List[bool]:
+        # Synchronous on purpose: OpenSSL verifies are ~150 µs each and the
+        # target hosts are core-starved — a thread handoff per burst was
+        # measured strictly worse (GIL/scheduler ping-pong, cf. store.py).
+        return self.verify_batch_mask(messages, keys, sigs)
+
 
 _backend = CpuBackend()
 
@@ -70,6 +81,22 @@ def verify_batch_mask(
     if not messages:
         return []
     return list(_backend.verify_batch_mask(messages, keys, sigs))
+
+
+async def averify_batch_mask(
+    messages: Sequence[bytes],
+    keys: Sequence[PublicKey],
+    sigs: Sequence[Signature],
+) -> List[bool]:
+    """Async verify_batch_mask: the TPU backend runs the device round trip
+    in an executor thread so the node's event loop (networking, proposer
+    timers, waiters) keeps running during the dispatch+sync — without this,
+    every Core burst would stall the whole primary for the device latency."""
+    if not (len(messages) == len(keys) == len(sigs)):
+        raise ValueError("verify_batch: length mismatch")
+    if not messages:
+        return []
+    return list(await _backend.averify_batch_mask(messages, keys, sigs))
 
 
 def verify_batch(

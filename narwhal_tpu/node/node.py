@@ -57,9 +57,39 @@ async def spawn_primary_node(
     loop = asyncio.get_running_loop()
     node.store = Store(store_path)
 
+    # If the TPU verify backend is selected, compile/cache-load the kernel
+    # for the live burst shapes BEFORE joining the committee: the first
+    # device call can cost tens of seconds of XLA compile, which must not
+    # land on the first certificate's critical path.
+    from ..crypto import backend as crypto_backend
+
+    backend = crypto_backend.get_backend()
+    if hasattr(backend, "warmup"):
+        log.info("Warming up %s verify backend...", backend.name)
+        backend.warmup()
+        log.info("Verify backend %s ready", backend.name)
+
     tx_new_certificates = asyncio.Queue(maxsize=CHANNEL_CAPACITY)
     tx_feedback = asyncio.Queue(maxsize=CHANNEL_CAPACITY)
     tx_output = asyncio.Queue(maxsize=CHANNEL_CAPACITY)
+
+    # Same for the consensus kernel: compile its one static window shape
+    # before the primary joins the committee (KernelTusk.prewarm docstring),
+    # which is why the Consensus is built before Primary.spawn logs the
+    # boot banner the harness waits on.
+    consensus = Consensus(
+        committee,
+        parameters.gc_depth,
+        rx_primary=tx_new_certificates,
+        tx_primary=tx_feedback,
+        tx_output=tx_output,
+        benchmark=benchmark,
+        use_kernel=use_kernel,
+    )
+    if hasattr(consensus.tusk, "prewarm"):
+        log.info("Warming up consensus kernel...")
+        consensus.tusk.prewarm()
+        log.info("Consensus kernel ready")
 
     node.primary = await Primary.spawn(
         keypair,
@@ -69,15 +99,6 @@ async def spawn_primary_node(
         tx_consensus=tx_new_certificates,
         rx_consensus=tx_feedback,
         benchmark=benchmark,
-    )
-    consensus = Consensus(
-        committee,
-        parameters.gc_depth,
-        rx_primary=tx_new_certificates,
-        tx_primary=tx_feedback,
-        tx_output=tx_output,
-        benchmark=benchmark,
-        use_kernel=use_kernel,
     )
     node.tasks.append(loop.create_task(consensus.run()))
 

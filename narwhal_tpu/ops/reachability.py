@@ -208,7 +208,38 @@ class KernelTusk(Tusk):
     certificate-for-certificate by tests/test_reachability.py), with the
     window traversals collapsed into one :func:`leader_chain_scan`.  The
     emission DFS (``order_dag``) stays host-side — it is O(output) and must
-    produce the exact reference DFS tie-order."""
+    produce the exact reference DFS tie-order.
+
+    The scan runs at ONE static window shape — the smallest power of two
+    covering gc_depth+2 rounds, compiled once by :meth:`prewarm` — because
+    GC bounds the live DAG span to gc_depth rounds (consensus/src/lib.rs:
+    56-61).  A span beyond that (only possible transiently, e.g. a commit
+    stall racing GC) falls back to the golden Python walk instead of
+    triggering a fresh XLA compile of a bigger shape on the consensus
+    critical path."""
+
+    def __init__(self, committee, gc_depth, fixed_coin: bool = False) -> None:
+        super().__init__(committee, gc_depth, fixed_coin=fixed_coin)
+        w = 8
+        while w < gc_depth + 2:
+            w <<= 1
+        self.max_window = w
+        self.python_fallbacks = 0  # observability: stalls beyond the window
+
+    def prewarm(self) -> None:
+        """Compile (or cache-load) the scan at its one static shape off the
+        commit critical path (call at node boot)."""
+        n = len(self._sorted_keys)
+        W = self.max_window
+        leader_chain_scan(
+            jnp.zeros((W, n, n), bool),
+            jnp.zeros((W, n), bool),
+            jnp.zeros((W, n), bool),
+            jnp.zeros((W,), bool),
+            jnp.int32(0),
+            jnp.zeros((n,), bool),
+            W,
+        )
 
     def _leader_name(self, round_: int):
         coin = 0 if self.fixed_coin else round_
@@ -220,9 +251,10 @@ class KernelTusk(Tusk):
         n = len(names)
         base = max(0, state.last_committed_round)
         span = leader.round - base + 1
-        window = 8
-        while window < span:
-            window <<= 1
+        window = self.max_window
+        if span > window:
+            self.python_fallbacks += 1
+            return super().order_leaders(leader)
         win = DagWindow(state.dag, names, base, window)
 
         leader_onehot = np.zeros((window, n), dtype=bool)

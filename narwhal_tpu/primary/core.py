@@ -327,7 +327,9 @@ class Core:
                 keys.append(k)
                 sigs.append(s)
         mask = (
-            crypto_backend.verify_batch_mask(msgs, keys, sigs) if msgs else []
+            await crypto_backend.averify_batch_mask(msgs, keys, sigs)
+            if msgs
+            else []
         )
         for item, (off, count) in zip(items, spans):
             sig_ok = all(mask[off : off + count])

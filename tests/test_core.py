@@ -272,13 +272,13 @@ def test_burst_verifies_in_one_backend_call(run):
         forged.signature = Signature(bytes(64))
 
         calls = []
-        real = cb.verify_batch_mask
+        real = cb.averify_batch_mask
 
-        def counting(msgs, ks, ss):
+        async def counting(msgs, ks, ss):
             calls.append(len(msgs))
-            return real(msgs, ks, ss)
+            return await real(msgs, ks, ss)
 
-        cb.verify_batch_mask, orig = counting, cb.verify_batch_mask
+        cb.averify_batch_mask, orig = counting, cb.averify_batch_mask
         try:
             for h in headers:
                 await qs["primaries"].put(("header", h))
@@ -294,7 +294,7 @@ def test_burst_verifies_in_one_backend_call(run):
             assert calls and calls[0] == 4, calls
             task.cancel()
         finally:
-            cb.verify_batch_mask = orig
+            cb.averify_batch_mask = orig
             core.network.close()
             await recv.shutdown()
 

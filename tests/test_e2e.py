@@ -89,3 +89,152 @@ def test_four_node_commit(run):
             await node.shutdown()
 
     run(go())
+
+
+def test_multi_worker_commit(run):
+    """Horizontal payload sharding (reference config/src/lib.rs:230-246):
+    4 nodes × 2 workers; clients feed BOTH workers of node 0, and batches
+    sealed by each worker id must be committed — proving the per-worker-id
+    broadcast planes, digest‖worker_id payload keying, and the primary's
+    payload bookkeeping work end to end."""
+
+    async def go():
+        c = committee(base_port=14200, workers=2)
+        params = Parameters(
+            header_size=32,
+            max_header_delay=100,
+            batch_size=400,
+            max_batch_delay=100,
+        )
+        commits = {i: [] for i in range(4)}
+        nodes = []
+        for i, kp in enumerate(keys()):
+            nodes.append(
+                await spawn_primary_node(
+                    kp,
+                    c,
+                    params,
+                    on_commit=lambda cert, i=i: commits[i].append(cert),
+                )
+            )
+            for wid in (0, 1):
+                nodes.append(await spawn_worker_node(kp, wid, c, params))
+
+        from narwhal_tpu.crypto import digest32
+        from narwhal_tpu.messages import encode_batch
+
+        expected = {}  # digest -> worker id that must have sealed it
+        writers = []
+        for wid in (0, 1):
+            host, port = parse_address(
+                c.worker(keys()[0].name, wid).transactions
+            )
+            _, w = await asyncio.open_connection(host, port)
+            writers.append(w)
+            txs = [
+                bytes([1]) + (wid * 100 + i).to_bytes(8, "little") + bytes(91)
+                for i in range(4)
+            ]
+            for tx in txs:
+                await write_frame(w, tx)
+            expected[digest32(encode_batch(txs))] = wid
+
+        def committed_payload(certs):
+            return {
+                d: wid
+                for cert in certs
+                for d, wid in cert.header.payload.items()
+            }
+
+        for _ in range(600):
+            if all(
+                set(expected) <= set(committed_payload(v))
+                for v in commits.values()
+            ):
+                break
+            await asyncio.sleep(0.1)
+        else:
+            raise AssertionError(
+                "multi-worker payload never committed: "
+                f"{[len(v) for v in commits.values()]}"
+            )
+
+        # Every committed digest is attributed to the worker that sealed it.
+        for i in range(4):
+            payload = committed_payload(commits[i])
+            for d, wid in expected.items():
+                assert payload[d] == wid, (i, payload[d], wid)
+
+        for w in writers:
+            w.close()
+        for node in nodes:
+            await node.shutdown()
+
+    run(go())
+
+
+def test_commit_with_crash_fault(run):
+    """f=1 crash fault: the last node never boots (the reference's fault
+    injection, benchmark/local.py:77); the 3 live nodes (2f+1 stake) must
+    still drive rounds and commit client transactions."""
+
+    async def go():
+        c = committee(base_port=14400)
+        params = Parameters(
+            header_size=32,
+            max_header_delay=100,
+            batch_size=400,
+            max_batch_delay=100,
+        )
+        live = keys()[:3]  # node 3 is crashed from the start
+        commits = {i: [] for i in range(3)}
+        nodes = []
+        for i, kp in enumerate(live):
+            nodes.append(
+                await spawn_primary_node(
+                    kp,
+                    c,
+                    params,
+                    on_commit=lambda cert, i=i: commits[i].append(cert),
+                )
+            )
+            nodes.append(await spawn_worker_node(kp, 0, c, params))
+
+        host, port = parse_address(c.worker(live[0].name, 0).transactions)
+        _, w = await asyncio.open_connection(host, port)
+        txs = [bytes([1]) + i.to_bytes(8, "little") + bytes(91) for i in range(4)]
+        for tx in txs:
+            await write_frame(w, tx)
+
+        from narwhal_tpu.crypto import digest32
+        from narwhal_tpu.messages import encode_batch
+
+        expected = digest32(encode_batch(txs))
+
+        def payload_committed(certs):
+            return expected in {
+                d for cert in certs for d in cert.header.payload
+            }
+
+        for _ in range(600):
+            if all(payload_committed(v) for v in commits.values()):
+                break
+            await asyncio.sleep(0.1)
+        else:
+            raise AssertionError(
+                "payload never committed under f=1: "
+                f"{[len(v) for v in commits.values()]}"
+            )
+
+        # The live nodes agree on the commit order.
+        seqs = [[cert.digest() for cert in commits[i]] for i in range(3)]
+        common = min(len(s) for s in seqs)
+        assert common > 0
+        for i in range(1, 3):
+            assert seqs[i][:common] == seqs[0][:common]
+
+        w.close()
+        for node in nodes:
+            await node.shutdown()
+
+    run(go())

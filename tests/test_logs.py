@@ -1,0 +1,133 @@
+"""Log-parser unit tests against synthetic fixtures.
+
+Reproduces the reference's measurement arithmetic exactly
+(reference benchmark/benchmark/logs.py:155-198): consensus duration runs
+first *proposal* (Created line) → last commit, consensus latency is
+commit − proposal per committed digest, end-to-end duration starts at the
+client's `Start sending transactions` line, and the config echo-back from
+every primary must agree.
+"""
+
+import sys
+import os
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmark.logs import parse_logs  # noqa: E402
+
+TX = 512
+
+
+def _t(ms: int) -> str:
+    """Millisecond offset → log timestamp (fixed date)."""
+    s, msec = divmod(ms, 1000)
+    mins, sec = divmod(s, 60)
+    return f"2026-01-01T00:{mins:02d}:{sec:02d}.{msec:03d}Z"
+
+
+CONFIG_ECHO = "\n".join(
+    [
+        _t(0) + " INFO narwhal.node Header size set to 1000 B",
+        _t(0) + " INFO narwhal.node Max header delay set to 100 ms",
+        _t(0) + " INFO narwhal.node Garbage collection depth set to 50 rounds",
+        _t(0) + " INFO narwhal.node Sync retry delay set to 5000 ms",
+        _t(0) + " INFO narwhal.node Sync retry nodes set to 3 nodes",
+        _t(0) + " INFO narwhal.node Batch size set to 500000 B",
+        _t(0) + " INFO narwhal.node Max batch delay set to 100 ms",
+    ]
+)
+
+
+def make_logs():
+    client = "\n".join(
+        [
+            _t(1000) + " INFO narwhal.client Start sending transactions",
+            _t(1000) + " INFO narwhal.client Transactions size: 512 B",
+            _t(1000) + " INFO narwhal.client Transactions rate: 1000 tx/s",
+            _t(1100) + " INFO narwhal.client Sending sample transaction 7",
+        ]
+    )
+    worker = "\n".join(
+        [
+            _t(1200) + " INFO narwhal.worker Batch AAA= contains sample tx 7",
+            _t(1200) + " INFO narwhal.worker Batch AAA= contains 102400 B",
+            _t(1600) + " INFO narwhal.worker Batch BBB= contains 51200 B",
+        ]
+    )
+    primary = "\n".join(
+        [
+            CONFIG_ECHO,
+            _t(1300) + " INFO narwhal.primary Created B1(H1=) -> AAA=",
+            _t(1700) + " INFO narwhal.primary Created B2(H2=) -> BBB=",
+            _t(1900) + " INFO narwhal.consensus Committed B1(H1=) -> AAA=",
+            _t(2300) + " INFO narwhal.consensus Committed B2(H2=) -> BBB=",
+        ]
+    )
+    return [client], [worker], [primary]
+
+
+def test_reference_arithmetic():
+    clients, workers, primaries = make_logs()
+    r = parse_logs(clients, workers, primaries, TX)
+    assert not r.errors, r.errors
+
+    # Consensus: duration = first Created (1.3 s) → last commit (2.3 s).
+    committed_bytes = 102400 + 51200
+    assert r.committed_bytes == committed_bytes
+    assert abs(r.duration_s - 1.0) < 1e-6
+    assert abs(r.consensus_bps - committed_bytes / 1.0) < 0.1
+    assert abs(r.consensus_tps - committed_bytes / TX / 1.0) < 0.1
+    # Latency: mean((1.9−1.3), (2.3−1.7)) = 600 ms — proposal-based, NOT
+    # batch-creation-based (the batch was created at 1.2 s).
+    assert abs(r.consensus_latency_ms - 600.0) < 0.1
+
+    # End-to-end: duration = client start (1.0 s) → last commit (2.3 s);
+    # latency = sample send (1.1 s) → commit of AAA (1.9 s) = 800 ms.
+    assert abs(r.end_to_end_bps - committed_bytes / 1.3) < 0.1
+    assert abs(r.end_to_end_latency_ms - 800.0) < 0.1
+    assert r.samples == 1
+
+    # Config echo-back parsed into the result.
+    assert r.config["batch_size"] == 500000
+    assert r.config["gc_depth"] == 50
+
+
+def test_committed_without_created_is_flagged():
+    clients, workers, primaries = make_logs()
+    primaries[0] = primaries[0].replace(
+        _t(1700) + " INFO narwhal.primary Created B2(H2=) -> BBB=\n", ""
+    )
+    r = parse_logs(clients, workers, primaries, TX)
+    assert any("no Created line" in e for e in r.errors)
+
+
+def test_config_echo_missing_is_flagged():
+    clients, workers, primaries = make_logs()
+    primaries[0] = primaries[0].replace(
+        " INFO narwhal.node Batch size set to 500000 B\n", "\n"
+    )
+    r = parse_logs(clients, workers, primaries, TX)
+    assert any("config echo missing" in e for e in r.errors)
+
+
+def test_config_echo_mismatch_is_flagged():
+    clients, workers, primaries = make_logs()
+    second = primaries[0].replace(
+        "Batch size set to 500000 B", "Batch size set to 9 B"
+    )
+    r = parse_logs(clients, workers, primaries + [second], TX)
+    assert any("config echo differs" in e for e in r.errors)
+
+
+def test_earliest_timestamp_wins_across_primaries():
+    clients, workers, primaries = make_logs()
+    # A second primary saw the commit of AAA= later; earliest must win.
+    late = "\n".join(
+        [
+            CONFIG_ECHO,
+            _t(2500) + " INFO narwhal.consensus Committed B1(H1=) -> AAA=",
+        ]
+    )
+    r = parse_logs(clients, workers, primaries + [late], TX)
+    assert not r.errors, r.errors
+    assert abs(r.consensus_latency_ms - 600.0) < 1e-3  # unchanged

@@ -162,3 +162,59 @@ def test_fuzz_small_gc_depth():
     for _ in range(3):
         certs = _random_dag_certs(rng, rounds=14)
         both(certs, gc_depth=4)
+
+
+def test_window_capped_one_static_shape():
+    """The kernel window is a single static shape derived from gc_depth
+    (VERDICT r2: unbounded power-of-two growth meant a commit stall could
+    trigger fresh XLA compiles on the consensus critical path)."""
+    c = committee()
+    for gc_depth, want in ((6, 8), (14, 16), (50, 64), (126, 128)):
+        k = KernelTusk(c, gc_depth=gc_depth, fixed_coin=True)
+        assert k.max_window == want, (gc_depth, k.max_window)
+
+
+def test_stall_beyond_window_falls_back_to_python():
+    """A DAG span exceeding the static window must use the golden Python
+    walk (same output, zero new compiled shapes) instead of growing the
+    kernel window."""
+    import narwhal_tpu.ops.reachability as R
+
+    c = committee()
+    names = sorted_names()
+    # Stall: the fixed-coin leader (names[0]) is dead for rounds 1-17, so
+    # nothing commits while the DAG grows 17 rounds past genesis.  It then
+    # revives; the round-18 leader gets support and the first commit spans
+    # 19 rounds > window 8 (gc_depth 6).
+    certs1, parents = make_certificates(1, 17, genesis_digests(c), names[1:])
+    certs2, parents = make_certificates(18, 19, parents, names)
+    _, trigger = mock_certificate(names[0], 20, parents)
+    # After the catch-up commit the span is small again: further rounds
+    # must go through the kernel path at the one static shape.
+    certs3, parents = make_certificates(20, 23, parents, names)
+    _, trigger2 = mock_certificate(names[1], 24, parents)
+    all_certs = certs1 + certs2 + [trigger] + certs3 + [trigger2]
+
+    kernel_tusk = KernelTusk(c, gc_depth=6, fixed_coin=True)
+    calls = []
+    real = R.leader_chain_scan
+
+    def counting(*args, **kw):
+        calls.append(args[-1] if not kw else kw.get("window"))
+        return real(*args, **kw)
+
+    R.leader_chain_scan = counting
+    try:
+        kernel = feed(kernel_tusk, all_certs)
+    finally:
+        R.leader_chain_scan = real
+
+    golden_same_depth = feed(Tusk(c, gc_depth=6, fixed_coin=True), all_certs)
+    assert [x.digest() for x in kernel] == [
+        x.digest() for x in golden_same_depth
+    ]
+    assert kernel, "nothing committed — fixture broken"
+    assert kernel_tusk.python_fallbacks >= 1
+    # The kernel path did run after the stall, always at the static shape.
+    assert calls, "kernel never used after catch-up"
+    assert all(w == kernel_tusk.max_window for w in calls), calls
