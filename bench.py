@@ -109,6 +109,10 @@ def main() -> None:
             result.consensus_tps,
             46_478.0,
         )
+    # Errors are part of the artifact: a bench that publishes 0.0 with a
+    # clean rc is worse than one that fails loudly (rounds 3-4 did exactly
+    # that).  Zero committed transactions = failed measurement = rc 1.
+    errors = [e for r in results for e in r.errors]
     print(
         json.dumps(
             {
@@ -119,10 +123,18 @@ def main() -> None:
                 "runs_e2e_tps": [round(r.end_to_end_tps, 1) for r in results],
                 "consensus_latency_ms": round(result.consensus_latency_ms, 1),
                 "end_to_end_latency_ms": round(result.end_to_end_latency_ms, 1),
+                **({"errors": errors[:10]} if errors else {}),
                 **crypto,
             }
         )
     )
+    if result.committed_batches == 0 or tps <= 0:
+        print(
+            "BENCH FAILED: no committed transactions measured; "
+            f"errors={errors[:10]}",
+            file=sys.stderr,
+        )
+        sys.exit(1)
 
 
 if __name__ == "__main__":

@@ -93,7 +93,16 @@ def run_bench(
     quiet: bool = False,
     crypto_backend: str = None,
     consensus_kernel: bool = False,
+    tpu_primaries: int = None,
 ):
+    """Run one committee + clients on localhost; return the ParseResult.
+
+    ``tpu_primaries`` limits the TPU flags (``crypto_backend="tpu"`` /
+    ``consensus_kernel``) to the first N primaries: a single host has one
+    chip, so a mixed committee (one device-backed primary, the rest CPU)
+    is the honest way to exercise the device path end-to-end.  ``None``
+    means every primary gets the flags (all-CPU or all-TPU runs).
+    """
     kill_stale_nodes()
     workdir = workdir or os.path.join(REPO, ".bench")
     shutil.rmtree(workdir, ignore_errors=True)
@@ -122,18 +131,21 @@ def run_bench(
     for i, kp in enumerate(keypairs):
         export_keypair(kp, f"{workdir}/node-{i}.json")
 
-    # Prepend (not overwrite) PYTHONPATH: the host environment may inject
-    # interpreter-startup hooks through it (e.g. the TPU platform plugin
-    # registers via a sitecustomize on PYTHONPATH — dropping it leaves
-    # JAX_PLATFORMS pointing at a platform that never loads).
-    pythonpath = os.pathsep.join(
-        p for p in [REPO, os.environ.get("PYTHONPATH", "")] if p
-    )
-    env = dict(os.environ, PYTHONPATH=pythonpath)
+    # Child PYTHONPATH: REPO only.  The host environment may carry
+    # interpreter-startup hooks on PYTHONPATH (the TPU platform plugin
+    # registers via a sitecustomize); on a shared-core host that hook costs
+    # ~2 s of CPU per interpreter start, and forwarding it to 12 CPU-only
+    # children serializes ~25 s of boot into the measurement window — the
+    # round-3/4 "0.0 TPS" failure.  Only children that actually need the
+    # device (TPU-flagged primaries) get the host path appended.
+    cpu_env = dict(os.environ, PYTHONPATH=REPO)
+    host_pp = os.environ.get("PYTHONPATH", "")
+    tpu_pp = os.pathsep.join(p for p in [REPO, host_pp] if p)
+    tpu_env = dict(os.environ, PYTHONPATH=tpu_pp)
     procs = []
     primary_logs, worker_logs, client_logs = [], [], []
 
-    def spawn(cmd, logfile):
+    def spawn(cmd, logfile, env=cpu_env):
         f = open(logfile, "w")
         p = subprocess.Popen(
             cmd, stdout=f, stderr=subprocess.STDOUT, env=env, cwd=REPO
@@ -141,14 +153,21 @@ def run_bench(
         procs.append((p, f))
         return p
 
-    node_flags = []
-    if crypto_backend:
-        node_flags += ["--crypto-backend", crypto_backend]
+    # Device-requiring flags go only to the TPU-designated primaries; any
+    # other explicitly requested flag (e.g. --crypto-backend cpu) goes to
+    # every node unconditionally.
+    base_flags, device_flags = [], []
+    if crypto_backend == "tpu":
+        device_flags += ["--crypto-backend", "tpu"]
+    elif crypto_backend:
+        base_flags += ["--crypto-backend", crypto_backend]
     if consensus_kernel:
-        node_flags += ["--consensus-kernel"]
+        device_flags += ["--consensus-kernel"]
 
     alive = nodes - faults  # crash faults: the last `faults` nodes never boot
+    any_tpu = bool(device_flags)
     for i in range(alive):
+        on_tpu = any_tpu and (tpu_primaries is None or i < tpu_primaries)
         log = f"{workdir}/primary-{i}.log"
         primary_logs.append(log)
         spawn(
@@ -166,10 +185,12 @@ def run_bench(
                 "--store",
                 f"{storedir}/db-primary-{i}",
                 "--benchmark",
-                *node_flags,
+                *base_flags,
+                *(device_flags if on_tpu else []),
                 "primary",
             ],
             log,
+            env=tpu_env if on_tpu else cpu_env,
         )
         for wid in range(workers):
             log = f"{workdir}/worker-{i}-{wid}.log"
@@ -196,23 +217,25 @@ def run_bench(
                 log,
             )
 
-    # TPU-backed nodes spend tens of seconds warming the XLA kernels at
-    # boot; don't start the measured load until every primary reports
-    # booted, or the warmup eats the run window.
-    if crypto_backend == "tpu" or consensus_kernel:
-        deadline = time.time() + 600
-        pending = set(primary_logs)
-        while pending and time.time() < deadline:
-            for p in list(pending):
-                try:
-                    if "successfully booted" in open(p).read():
-                        pending.discard(p)
-                except OSError:
-                    pass
-            if pending:
-                time.sleep(2)
-        if pending and not quiet:
-            print(f"WARNING: primaries never booted: {pending}", file=sys.stderr)
+    # Never start the measured load against a committee that hasn't booted:
+    # the e2e window opens at the first client's "Start sending" line, so
+    # any boot time the clients outrun is charged to the measurement (the
+    # round-3/4 failure measured a committee that never came up at all).
+    # TPU-backed nodes additionally spend tens of seconds warming XLA
+    # kernels, hence the much longer deadline.
+    deadline = time.time() + (600 if any_tpu else 60)
+    pending = set(primary_logs + worker_logs)
+    while pending and time.time() < deadline:
+        for p in list(pending):
+            try:
+                if "successfully booted" in open(p).read():
+                    pending.discard(p)
+            except OSError:
+                pass
+        if pending:
+            time.sleep(0.2)
+    if pending and not quiet:
+        print(f"WARNING: nodes never booted: {pending}", file=sys.stderr)
 
     # One client per live worker, rate split evenly (reference local.py:78).
     committee_obj = committee
@@ -288,6 +311,13 @@ def main():
     parser.add_argument("--json", action="store_true")
     parser.add_argument("--crypto-backend", choices=["cpu", "tpu"], default=None)
     parser.add_argument("--consensus-kernel", action="store_true")
+    parser.add_argument(
+        "--tpu-primaries",
+        type=int,
+        default=None,
+        help="Apply the TPU flags to only the first N primaries "
+        "(single-chip hosts: use 1)",
+    )
     args = parser.parse_args()
 
     result = run_bench(
@@ -300,6 +330,7 @@ def main():
         base_port=args.base_port,
         crypto_backend=args.crypto_backend,
         consensus_kernel=args.consensus_kernel,
+        tpu_primaries=args.tpu_primaries,
     )
     if result.errors:
         print("ERRORS detected in logs:", file=sys.stderr)

@@ -29,16 +29,31 @@ class CpuBackend:
     ) -> List[bool]:
         return [cpu_verify(m, k, s) for m, k, s in zip(messages, keys, sigs)]
 
+    # Inline chunk size: ~64 OpenSSL verifies ≈ 10 ms — the max the event
+    # loop may stall between yields.  A thread handoff per burst was
+    # measured strictly worse on core-starved hosts (GIL/scheduler
+    # ping-pong, cf. store.py), so big bursts stay on-loop but cooperative.
+    AVERIFY_CHUNK = 64
+
     async def averify_batch_mask(
         self,
         messages: Sequence[bytes],
         keys: Sequence[PublicKey],
         sigs: Sequence[Signature],
     ) -> List[bool]:
-        # Synchronous on purpose: OpenSSL verifies are ~150 µs each and the
-        # target hosts are core-starved — a thread handoff per burst was
-        # measured strictly worse (GIL/scheduler ping-pong, cf. store.py).
-        return self.verify_batch_mask(messages, keys, sigs)
+        n = len(messages)
+        if n <= self.AVERIFY_CHUNK:
+            return self.verify_batch_mask(messages, keys, sigs)
+        import asyncio
+
+        out: List[bool] = []
+        for i in range(0, n, self.AVERIFY_CHUNK):
+            j = i + self.AVERIFY_CHUNK
+            out.extend(self.verify_batch_mask(messages[i:j], keys[i:j], sigs[i:j]))
+            # Yield between chunks so network/timers keep running during a
+            # committee-sized burst (tens of ms of crypto at N=20+).
+            await asyncio.sleep(0)
+        return out
 
 
 _backend = CpuBackend()

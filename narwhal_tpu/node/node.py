@@ -65,8 +65,22 @@ async def spawn_primary_node(
 
     backend = crypto_backend.get_backend()
     if hasattr(backend, "warmup"):
+        from ..primary.core import Core
+
+        # Largest claim batch a Core burst can produce: DRAIN_LIMIT items,
+        # each a certificate carrying its header claim plus one quorum of
+        # vote claims — warm every pad shape up to it so no live burst hits
+        # XLA compile.  Worst case is the LARGEST vote set that can form a
+        # quorum (smallest stakes first), not the smallest.
+        stakes = sorted(a.stake for a in committee.authorities.values())
+        acc, worst_votes = 0, 0
+        for s in stakes:
+            acc += s
+            worst_votes += 1
+            if acc >= committee.quorum_threshold():
+                break
         log.info("Warming up %s verify backend...", backend.name)
-        backend.warmup()
+        backend.warmup(max_claims=Core.DRAIN_LIMIT * (worst_votes + 1))
         log.info("Verify backend %s ready", backend.name)
 
     tx_new_certificates = asyncio.Queue(maxsize=CHANNEL_CAPACITY)

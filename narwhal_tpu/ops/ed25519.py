@@ -391,16 +391,30 @@ class TpuBackend:
             self._executor, self.verify_batch_mask, messages, keys, sigs
         )
 
-    def warmup(self, shapes: Sequence[int] = None) -> None:
+    def warmup(
+        self, shapes: Sequence[int] = None, max_claims: int = None
+    ) -> None:
         """Compile (or load from the persistent cache) the kernel for the
         padded batch shapes a live node will hit, so the first real burst
         doesn't pay tens of seconds of XLA compile on the critical path.
-        Default shapes cover a small committee's bursts (pad=16 dominates
-        at 4 nodes); override via NARWHAL_TPU_WARMUP_SHAPES="16,64,256"
-        for larger committees."""
+
+        ``max_claims`` is the largest claim batch the node can produce —
+        Core.DRAIN_LIMIT items × one quorum (2f+1) of vote claims each; the
+        caller (node boot) derives it from the committee so every power-of-
+        two pad shape up to it is compiled before the node joins.  Explicit
+        ``shapes`` or NARWHAL_TPU_WARMUP_SHAPES="16,64,256" override."""
         if shapes is None:
-            env = os.environ.get("NARWHAL_TPU_WARMUP_SHAPES", "16,64")
-            shapes = [int(s) for s in env.split(",") if s]
+            env = os.environ.get("NARWHAL_TPU_WARMUP_SHAPES")
+            if env:
+                shapes = [int(s) for s in env.split(",") if s]
+            else:
+                top = 64 if max_claims is None else max(16, max_claims)
+                shapes, pad = [], 16
+                while True:
+                    shapes.append(pad)
+                    if pad >= top:
+                        break
+                    pad <<= 1
         from ..crypto import KeyPair
         from ..crypto.digest import Digest
 

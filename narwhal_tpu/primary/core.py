@@ -321,7 +321,7 @@ class Core:
                 if not stale and kind in ("header", "vote", "certificate")
                 else []
             )
-            spans.append((len(msgs), len(claims)))
+            spans.append((len(msgs), len(claims), stale))
             for m, k, s in claims:
                 msgs.append(m)
                 keys.append(k)
@@ -331,8 +331,13 @@ class Core:
             if msgs
             else []
         )
-        for item, (off, count) in zip(items, spans):
-            sig_ok = all(mask[off : off + count])
+        for item, (off, count, stale) in zip(items, spans):
+            # Fail CLOSED on stale-filtered items: they carry zero verified
+            # claims, so `all([])` would hand them sig_ok=True.  Today the
+            # replay raises TooOld on the same round checks before ever
+            # consulting sig_ok, but any future drift between this
+            # pre-filter and sanitize_* must not skip the signature gate.
+            sig_ok = (not stale) and all(mask[off : off + count])
             await self._handle("primaries", item, sig_ok)
 
     async def run(self) -> None:

@@ -36,6 +36,7 @@ class Store:
         self._obligations: Dict[bytes, List[asyncio.Future]] = {}
         self._fd: Optional[int] = None
         self._size = 0  # valid log length (single writer: we own the file)
+        self._failed = False  # log lost its record boundary; writes refuse
         if path is not None:
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
             if os.path.exists(path):
@@ -65,8 +66,15 @@ class Store:
         self._size = pos
 
     def write(self, key: bytes, value: bytes) -> None:
-        self._map[key] = value
+        if self._failed:
+            # The log lost its record boundary (see below): refusing loudly
+            # beats silently keeping memory-only state the next replay will
+            # never see.  The reference aborts on storage failure too
+            # (core.rs:392-395).
+            raise OSError("store log is failed; refusing further writes")
         if self._fd is not None:
+            # Log FIRST, memory after: a failed append must leave memory and
+            # log agreeing (both without the record), not diverged.
             # One writev() per record: no serialization copy, atomic w.r.t.
             # our own replay logic (torn tails are discarded on open).
             # writev may write short (signal, ENOSPC cleared later): retry
@@ -84,9 +92,24 @@ class Store:
                 # A torn record would strand every later append behind it on
                 # replay (truncation stops at the first torn record): roll
                 # the file back to the record boundary before propagating.
-                os.ftruncate(self._fd, self._size)
+                try:
+                    os.ftruncate(self._fd, self._size)
+                except OSError:
+                    # Boundary unrecoverable — poison the store so later
+                    # writes fail instead of appending unreachable records.
+                    # The fd must end up cleared even if close() itself
+                    # fails on the dying device (else Store.close() would
+                    # double-close a reused fd number).
+                    self._failed = True
+                    try:
+                        os.close(self._fd)
+                    except OSError:
+                        pass
+                    finally:
+                        self._fd = None
                 raise
             self._size += total
+        self._map[key] = value
         # Wake every parked notify_read on this key.
         waiters = self._obligations.pop(key, None)
         if waiters:

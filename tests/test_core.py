@@ -299,3 +299,31 @@ def test_burst_verifies_in_one_backend_call(run):
             await recv.shutdown()
 
     run(go())
+
+
+def test_stale_burst_item_replays_fail_closed(run):
+    """A stale-filtered burst item carries zero crypto claims; it must be
+    replayed with sig_ok=False (fail closed), never `all([]) == True` —
+    regression for the round-3 advisor finding on core.py's pre-filter."""
+
+    async def go():
+        c = committee()
+        me, author = keys()[0], keys()[1]
+        core, store, qs = make_core(c, me)
+        core.gc_round = 10
+        stale = make_header(author, round_=5, c=c)
+        fresh = make_header(author, round_=12, c=c)
+
+        seen = []
+
+        async def recording(source, item, sig_ok):
+            seen.append((item[1].id, sig_ok))
+
+        core._handle = recording
+        await core._handle_primaries_burst(
+            [("header", stale), ("header", fresh)]
+        )
+        assert seen == [(stale.id, False), (fresh.id, True)]
+        core.network.close()
+
+    run(go())

@@ -70,3 +70,31 @@ def test_torn_tail_discarded(tmp_path):
     s2 = Store(path)
     assert s2.read(b"a") == b"1"
     s2.close()
+
+
+def test_failed_append_keeps_memory_and_log_consistent(tmp_path, monkeypatch):
+    """A failed log append must leave memory WITHOUT the record too (fail
+    together), roll the file back to the record boundary, and keep the
+    store usable — regression for the round-3 advisor finding."""
+    path = os.path.join(tmp_path, "store.log")
+    s = Store(path)
+    s.write(b"a", b"1")
+
+    import pytest
+
+    def boom(fd, bufs):
+        raise OSError("injected disk error")
+
+    monkeypatch.setattr(os, "writev", boom)
+    with pytest.raises(OSError):
+        s.write(b"b", b"2")
+    monkeypatch.undo()
+
+    assert s.read(b"b") is None  # memory did not diverge from the log
+    s.write(b"c", b"3")  # boundary intact: later appends still replayable
+    s.close()
+    s2 = Store(path)
+    assert s2.read(b"a") == b"1"
+    assert s2.read(b"b") is None
+    assert s2.read(b"c") == b"3"
+    s2.close()
