@@ -1,0 +1,26 @@
+# One-command build + test entry point (the reference's CI does the same
+# four steps: build all targets, test, fmt, lint — .github/workflows/rust.yml).
+#
+#   make check     build the native data plane, then run the test suite
+#   make native    build native/libnarwhal_dp.so only
+#   make bench     one driver benchmark run (prints the JSON line)
+#   make clean     remove build products and bench scratch
+
+PYTHON ?= python
+
+.PHONY: check native test bench clean
+
+check: native test
+
+native:
+	$(MAKE) -C native
+
+test:
+	$(PYTHON) -m pytest tests/ -x -q
+
+bench: native
+	$(PYTHON) bench.py
+
+clean:
+	$(MAKE) -C native clean
+	rm -rf .bench .bench_remote .pytest_cache

@@ -34,13 +34,18 @@ from narwhal_tpu.crypto import KeyPair  # noqa: E402
 from benchmark.logs import parse_logs  # noqa: E402
 
 
-def build_committee(keypairs, base_port, workers):
+def build_committee(keypairs, base_port, workers, ips=None):
+    """Sequential port allocation, one block of 2+3W ports per authority
+    (reference config.py:63-86).  ``ips`` optionally maps authority index →
+    IP for multi-host committees; default is all-loopback."""
     port = base_port
     auths = {}
-    for kp in keypairs:
+    for i, kp in enumerate(keypairs):
+        ip = ips[i] if ips else "127.0.0.1"
+
         def nxt():
             nonlocal port
-            a = f"127.0.0.1:{port}"
+            a = f"{ip}:{port}"
             port += 1
             return a
 

@@ -1,0 +1,91 @@
+"""Latency-vs-throughput plots from aggregate.py sweep artifacts.
+
+The reference renders its benchmark sweeps as latency-vs-throughput curves
+with a max-latency cutoff (benchmark/benchmark/plot.py:1-203: one curve per
+configuration, x = committed TPS, y = latency, points past the cutoff
+dropped — that cutoff is how the paper defines "saturation").  Same contract
+here, drawn from the JSON artifacts `benchmark/aggregate.py --out` writes:
+
+    python benchmark/plot.py artifacts/sweep_4n.json artifacts/sweep_20n.json \
+        --metric e2e --max-latency 8000 --out artifacts/latency_vs_tps.png
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+_METRICS = {
+    "e2e": ("end_to_end_tps", "end_to_end_latency_ms", "End-to-end"),
+    "consensus": ("consensus_tps", "consensus_latency_ms", "Consensus"),
+}
+
+
+def curve(artifact: dict, metric: str, max_latency_ms: float):
+    """(xs, ys, yerr, label) for one sweep artifact, cutoff applied."""
+    tps_key, lat_key, _ = _METRICS[metric]
+    xs, ys, yerr = [], [], []
+    for p in sorted(artifact["points"], key=lambda p: p["rate"]):
+        lat = p[lat_key]["mean"]
+        if lat <= 0 or lat > max_latency_ms:
+            continue  # past saturation (reference plot.py max-latency cutoff)
+        xs.append(p[tps_key]["mean"])
+        ys.append(lat)
+        yerr.append(p[lat_key]["stdev"])
+    cfg = artifact.get("config", {})
+    label = (
+        f"{cfg.get('nodes', '?')} nodes, {cfg.get('workers', '?')} wkr"
+        + (f", {cfg['faults']} faults" if cfg.get("faults") else "")
+    )
+    return xs, ys, yerr, label
+
+
+def plot(paths, metric: str, max_latency_ms: float, out: str) -> None:
+    import matplotlib
+
+    matplotlib.use("Agg")  # headless
+    import matplotlib.pyplot as plt
+
+    _, _, title = _METRICS[metric]
+    fig, ax = plt.subplots(figsize=(7, 4.5))
+    for path in paths:
+        with open(path) as f:
+            artifact = json.load(f)
+        xs, ys, yerr, label = curve(artifact, metric, max_latency_ms)
+        if not xs:
+            print(f"WARNING: no points under cutoff in {path}", file=sys.stderr)
+            continue
+        ax.errorbar(xs, ys, yerr=yerr, marker="o", capsize=3, label=label)
+    ax.set_xlabel(f"{title} throughput (tx/s)")
+    ax.set_ylabel(f"{title} latency (ms)")
+    ax.set_title(f"{title} latency vs throughput")
+    ax.grid(True, alpha=0.3)
+    ax.legend()
+    fig.tight_layout()
+    os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
+    fig.savefig(out, dpi=150)
+    print(f"wrote {out}")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("artifacts", nargs="+", help="aggregate.py --out JSONs")
+    ap.add_argument("--metric", choices=sorted(_METRICS), default="e2e")
+    ap.add_argument(
+        "--max-latency",
+        type=float,
+        default=10_000,
+        help="drop points slower than this (ms) — the saturation cutoff",
+    )
+    ap.add_argument("--out", default="artifacts/latency_vs_tps.png")
+    args = ap.parse_args()
+    plot(args.artifacts, args.metric, args.max_latency, args.out)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,377 @@
+"""Multi-host benchmark launcher: run one committee across several machines.
+
+The reference's remote harness (benchmark/benchmark/remote.py:139-311) does
+install (git clone + cargo build per host), config upload, node launch in
+per-host tmux sessions, log download, and reuses LogParser for the numbers;
+instance.py adds AWS-specific EC2 lifecycle.  This is the deployment-agnostic
+analog: a host is anything a `Runner` can reach — `ssh://user@ip` for real
+clusters (install = rsync of this checkout, no AWS dependency) or
+`local:<dir>` subprocess sandboxes, which give a faithful 2+-"host" run
+(separate working dirs, separate stores, full TCP mesh) on one machine and
+are what the test suite exercises.
+
+    python benchmark/remote_bench.py --hosts ssh://10.0.0.1 ssh://10.0.0.2 \
+        --rate 40000 --duration 30
+    python benchmark/remote_bench.py --hosts local:/tmp/h0 local:/tmp/h1 \
+        --nodes 4 --rate 10000 --duration 15
+
+Each authority i runs (primary + workers + its clients) on host i%H; the
+committee file carries each host's address, so all inter-authority traffic
+crosses the real network between hosts.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shlex
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from narwhal_tpu.config import Parameters, export_keypair  # noqa: E402
+from narwhal_tpu.crypto import KeyPair  # noqa: E402
+from benchmark.local_bench import build_committee  # noqa: E402
+from benchmark.logs import parse_logs  # noqa: E402
+
+
+class LocalRunner:
+    """A 'host' that is a directory on this machine (127.0.0.1 traffic).
+
+    Faithful to the SSH path — separate workdir, nohup'd processes, log
+    fetch — minus the wire between machines; used by tests and for smoke
+    runs without a cluster."""
+
+    def __init__(self, workdir: str):
+        self.workdir = os.path.abspath(workdir)
+        self.ip = "127.0.0.1"
+        os.makedirs(self.workdir, exist_ok=True)
+
+    def install(self) -> None:
+        # Same machine: the host's "repo" is a symlink to this checkout.
+        link = os.path.join(self.workdir, "repo")
+        if not os.path.islink(link):
+            os.symlink(REPO, link)
+
+    def put(self, local: str, remote_rel: str) -> None:
+        dst = os.path.join(self.workdir, remote_rel)
+        os.makedirs(os.path.dirname(dst) or ".", exist_ok=True)
+        subprocess.run(["cp", local, dst], check=True)
+
+    def get(self, remote_rel: str, local: str) -> None:
+        src = os.path.join(self.workdir, remote_rel)
+        os.makedirs(os.path.dirname(local) or ".", exist_ok=True)
+        subprocess.run(["cp", src, local], check=True)
+
+    def run(self, cmd: str, check: bool = True) -> subprocess.CompletedProcess:
+        return subprocess.run(
+            cmd, shell=True, cwd=self.workdir, check=check,
+            capture_output=True, text=True,
+        )
+
+
+class SshRunner:
+    """A host reached over ssh; install = rsync this checkout across.
+
+    ``workdir`` is relative to the login home (no tilde games): every
+    command runs from it, and all node/client paths are workdir-relative."""
+
+    def __init__(self, host: str, workdir: str = "narwhal_bench"):
+        # host: "user@ip" or "ip"
+        self.host = host
+        self.ip = host.split("@")[-1]
+        self.workdir = workdir
+
+    def install(self) -> None:
+        subprocess.run(
+            ["ssh", "-o", "BatchMode=yes", self.host,
+             f"mkdir -p {shlex.quote(self.workdir)}"],
+            check=True,
+        )
+        subprocess.run(
+            [
+                "rsync", "-az", "--delete",
+                "--exclude", ".git", "--exclude", ".bench",
+                "--exclude", "__pycache__", "--exclude", "*.pyc",
+                f"{REPO}/", f"{self.host}:{self.workdir}/repo/",
+            ],
+            check=True,
+        )
+        # Build the native data plane on the target's own toolchain.
+        self.run("make -C repo/native", check=False)
+
+    def put(self, local: str, remote_rel: str) -> None:
+        d = os.path.dirname(remote_rel)
+        if d:
+            self.run(f"mkdir -p {shlex.quote(d)}")
+        subprocess.run(
+            ["scp", "-q", local, f"{self.host}:{self.workdir}/{remote_rel}"],
+            check=True,
+        )
+
+    def get(self, remote_rel: str, local: str) -> None:
+        os.makedirs(os.path.dirname(local) or ".", exist_ok=True)
+        subprocess.run(
+            ["scp", "-q", f"{self.host}:{self.workdir}/{remote_rel}", local],
+            check=True,
+        )
+
+    def run(self, cmd: str, check: bool = True) -> subprocess.CompletedProcess:
+        return subprocess.run(
+            ["ssh", "-o", "BatchMode=yes", self.host,
+             f"cd {shlex.quote(self.workdir)} && {cmd}"],
+            check=check, capture_output=True, text=True,
+        )
+
+
+def make_runner(spec: str):
+    if spec.startswith("ssh://"):
+        return SshRunner(spec[len("ssh://"):])
+    if spec.startswith("local:"):
+        return LocalRunner(spec[len("local:"):])
+    raise ValueError(f"host spec must be ssh://... or local:<dir>, got {spec!r}")
+
+
+def _spawn_cmd(runner, args: list, logfile: str) -> None:
+    """Start a long-running node/client on the host, detached from the
+    launcher (reference runs each in a tmux session; nohup + pid file is
+    the dependency-free equivalent).  Paths in `args` are workdir-relative;
+    the process runs from the workdir with the rsynced repo on PYTHONPATH.
+    logs/ and pids/ were created by the per-host prep pass."""
+    quoted = " ".join(shlex.quote(a) for a in args)
+    runner.run(
+        f"PYTHONPATH=repo nohup python {quoted} > {shlex.quote(logfile)} 2>&1 & "
+        "echo $! >> pids/all"
+    )
+
+
+# Kill only pids whose live cmdline is actually one of our node/client
+# processes: pids/all can be stale across reboots/PID wrap, and a blind
+# `kill -9 $(cat pids/all)` would then hit unrelated processes (the local
+# harness's kill_stale_nodes() guards the same way via /proc cmdline).
+_KILL_OURS = (
+    "if [ -f pids/all ]; then for p in $(cat pids/all); do "
+    "grep -aq narwhal_tpu /proc/$p/cmdline 2>/dev/null && kill -{sig} $p; "
+    "done; fi; true"
+)
+
+
+def run_remote_bench(
+    hosts,
+    nodes: int = 4,
+    workers: int = 1,
+    rate: int = 20_000,
+    tx_size: int = 512,
+    duration: int = 30,
+    base_port: int = 7500,
+    batch_size: int = 500_000,
+    header_size: int = 1_000,
+    max_header_delay: int = 100,
+    max_batch_delay: int = 100,
+    install: bool = True,
+    keep_logs: bool = False,
+    quiet: bool = False,
+):
+    runners = [make_runner(h) for h in hosts]
+    if install:
+        for r in runners:
+            r.install()
+    # Per-host prep (reference remote.py `kill` task + fresh dirs): kill
+    # leftovers from a previous run, clear its stores/logs (an interrupted
+    # run never reached its own cleanup — replaying its multi-GB store logs
+    # would eat the next run's boot window), and create the run dirs once.
+    for r in runners:
+        r.run(_KILL_OURS.format(sig=9) + "; rm -f pids/all", check=False)
+        r.run(
+            "rm -rf db-primary-* db-worker-* logs && mkdir -p logs pids",
+            check=False,
+        )
+
+    stage = os.path.join(REPO, ".bench_remote")
+    subprocess.run(["rm", "-rf", stage], check=False)
+    os.makedirs(stage, exist_ok=True)
+
+    keypairs = [KeyPair.generate() for _ in range(nodes)]
+    committee = build_committee(
+        keypairs,
+        base_port,
+        workers,
+        ips=[runners[i % len(runners)].ip for i in range(nodes)],
+    )
+    committee.export(f"{stage}/committee.json")
+    Parameters(
+        header_size=header_size,
+        batch_size=batch_size,
+        max_header_delay=max_header_delay,
+        max_batch_delay=max_batch_delay,
+    ).export(f"{stage}/parameters.json")
+    for i, kp in enumerate(keypairs):
+        export_keypair(kp, f"{stage}/node-{i}.json")
+
+    # Upload configs (reference remote.py:161-211): shared files once per
+    # host, each authority's keypair to its own host only.
+    for r in runners:
+        r.put(f"{stage}/committee.json", "configs/committee.json")
+        r.put(f"{stage}/parameters.json", "configs/parameters.json")
+    for i in range(nodes):
+        runners[i % len(runners)].put(
+            f"{stage}/node-{i}.json", f"configs/node-{i}.json"
+        )
+
+    # Launch primaries and workers, then clients (reference remote.py:213-271).
+    primary_logs, worker_logs, client_logs = [], [], []
+    for i in range(nodes):
+        r = runners[i % len(runners)]
+        common = [
+            "-m", "narwhal_tpu.node", "run",
+            "--keys", f"configs/node-{i}.json",
+            "--committee", "configs/committee.json",
+            "--parameters", "configs/parameters.json",
+            "--benchmark",
+        ]
+        primary_logs.append((r, f"logs/primary-{i}.log"))
+        _spawn_cmd(
+            r,
+            common + ["--store", f"db-primary-{i}", "primary"],
+            f"logs/primary-{i}.log",
+        )
+        for w in range(workers):
+            worker_logs.append((r, f"logs/worker-{i}-{w}.log"))
+            _spawn_cmd(
+                r,
+                common + ["--store", f"db-worker-{i}-{w}", "worker", "--id", str(w)],
+                f"logs/worker-{i}-{w}.log",
+            )
+
+    # Same lesson as the local bench: never open the measurement window
+    # against a committee that hasn't booted.
+    deadline = time.time() + 120
+    pending = set(primary_logs + worker_logs)
+    while pending and time.time() < deadline:
+        for entry in list(pending):
+            r, rel = entry
+            cp = r.run(
+                f"grep -q 'successfully booted' {shlex.quote(rel)} && echo OK",
+                check=False,
+            )
+            if "OK" in (cp.stdout or ""):
+                pending.discard(entry)
+        if pending:
+            time.sleep(1)
+    if pending and not quiet:
+        names = [rel for _, rel in pending]
+        print(f"WARNING: nodes never booted: {names}", file=sys.stderr)
+
+    rate_share = max(1, rate // max(1, nodes * workers))
+    idx = 0
+    for i in range(nodes):
+        r = runners[i % len(runners)]
+        for w in range(workers):
+            addr = committee.worker(keypairs[i].name, w).transactions
+            client_logs.append((r, f"logs/client-{i}-{w}.log"))
+            _spawn_cmd(
+                r,
+                [
+                    "-m", "narwhal_tpu.node.benchmark_client", addr,
+                    "--size", str(tx_size),
+                    "--rate", str(rate_share),
+                    "--sample-offset", str(idx << 32),
+                    "--nodes", addr,
+                ],
+                f"logs/client-{i}-{w}.log",
+            )
+            idx += 1
+
+    if not quiet:
+        print(f"Running remote benchmark ({duration} s)...", file=sys.stderr)
+    time.sleep(duration)
+
+    for r in runners:
+        r.run(_KILL_OURS.format(sig="TERM"), check=False)
+    time.sleep(2)
+    for r in runners:
+        r.run(_KILL_OURS.format(sig=9) + "; rm -f pids/all", check=False)
+
+    # Fetch logs (reference remote.py `_logs`) and parse with the same
+    # LogParser the local bench uses.
+    def fetch(entries, kind):
+        texts = []
+        for j, (r, rel) in enumerate(entries):
+            local = f"{stage}/{kind}-{j}.log"
+            try:
+                r.get(rel, local)
+                texts.append(open(local).read())
+            except Exception as e:  # host unreachable: parse what we have
+                print(f"WARNING: fetch {rel}: {e}", file=sys.stderr)
+                texts.append("")
+        return texts
+
+    result = parse_logs(
+        fetch(client_logs, "client"),
+        fetch(worker_logs, "worker"),
+        fetch(primary_logs, "primary"),
+        tx_size,
+    )
+    for r in runners:
+        r.run("rm -rf db-primary-* db-worker-*", check=False)
+        if not keep_logs:
+            r.run("rm -rf logs", check=False)
+    return result
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--hosts", nargs="+", required=True,
+        help="ssh://user@ip or local:<dir> per host",
+    )
+    ap.add_argument("--nodes", type=int, default=4)
+    ap.add_argument("--workers", type=int, default=1)
+    ap.add_argument("--rate", type=int, default=20_000)
+    ap.add_argument("--tx-size", type=int, default=512)
+    ap.add_argument("--duration", type=int, default=30)
+    ap.add_argument("--base-port", type=int, default=7500)
+    ap.add_argument("--batch-size", type=int, default=500_000)
+    ap.add_argument("--no-install", action="store_true")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args()
+
+    result = run_remote_bench(
+        args.hosts,
+        nodes=args.nodes,
+        workers=args.workers,
+        rate=args.rate,
+        tx_size=args.tx_size,
+        duration=args.duration,
+        base_port=args.base_port,
+        batch_size=args.batch_size,
+        install=not args.no_install,
+    )
+    if result.errors:
+        print("ERRORS detected in logs:", file=sys.stderr)
+        for e in result.errors[:10]:
+            print("  " + e, file=sys.stderr)
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "consensus_tps": result.consensus_tps,
+                    "consensus_latency_ms": result.consensus_latency_ms,
+                    "end_to_end_tps": result.end_to_end_tps,
+                    "end_to_end_latency_ms": result.end_to_end_latency_ms,
+                    "samples": result.samples,
+                    "errors": result.errors[:10],
+                }
+            )
+        )
+    else:
+        print(result.summary(args.rate, args.tx_size, args.nodes, args.workers))
+    sys.exit(1 if result.errors or result.committed_batches == 0 else 0)
+
+
+if __name__ == "__main__":
+    main()
