@@ -94,7 +94,7 @@ def main() -> None:
     ap.add_argument("--duration", type=int, default=20)
     ap.add_argument("--tx-size", type=int, default=512)
     ap.add_argument("--faults", type=int, default=0)
-    ap.add_argument("--batch-size", type=int, default=125_000)
+    ap.add_argument("--batch-size", type=int, default=500_000)
     ap.add_argument("--base-port", type=int, default=7800)
     ap.add_argument("--out", type=str, default=None)
     args = ap.parse_args()

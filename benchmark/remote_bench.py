@@ -49,6 +49,7 @@ class LocalRunner:
     def __init__(self, workdir: str):
         self.workdir = os.path.abspath(workdir)
         self.ip = "127.0.0.1"
+        self.python = shlex.quote(sys.executable)
         os.makedirs(self.workdir, exist_ok=True)
 
     def install(self) -> None:
@@ -80,11 +81,18 @@ class SshRunner:
     ``workdir`` is relative to the login home (no tilde games): every
     command runs from it, and all node/client paths are workdir-relative."""
 
-    def __init__(self, host: str, workdir: str = "narwhal_bench"):
-        # host: "user@ip" or "ip"
+    def __init__(
+        self,
+        host: str,
+        workdir: str = "narwhal_bench",
+        python: str = "python3",
+    ):
+        # host: "user@ip" or "ip".  `python3`, not `python`: modern distros
+        # ship no bare `python` on the PATH.
         self.host = host
         self.ip = host.split("@")[-1]
         self.workdir = workdir
+        self.python = python
 
     def install(self) -> None:
         subprocess.run(
@@ -143,8 +151,12 @@ def _spawn_cmd(runner, args: list, logfile: str) -> None:
     the process runs from the workdir with the rsynced repo on PYTHONPATH.
     logs/ and pids/ were created by the per-host prep pass."""
     quoted = " ".join(shlex.quote(a) for a in args)
+    # NARWHAL_BIND_ANY: listen sockets bind 0.0.0.0 — committee addresses
+    # carry each host's *reachable* IP, which on NAT'd/cloud hosts is not a
+    # local interface address.
     runner.run(
-        f"PYTHONPATH=repo nohup python {quoted} > {shlex.quote(logfile)} 2>&1 & "
+        f"PYTHONPATH=repo NARWHAL_BIND_ANY=1 nohup {runner.python} {quoted} "
+        f"> {shlex.quote(logfile)} 2>&1 & "
         "echo $! >> pids/all"
     )
 
@@ -252,14 +264,19 @@ def run_remote_bench(
     deadline = time.time() + 120
     pending = set(primary_logs + worker_logs)
     while pending and time.time() < deadline:
-        for entry in list(pending):
-            r, rel = entry
+        # One batched grep per host per round (not one ssh exec per log):
+        # -l prints each file that matched, -s silences not-yet-created.
+        for r in runners:
+            files = [rel for rr, rel in pending if rr is r]
+            if not files:
+                continue
             cp = r.run(
-                f"grep -q 'successfully booted' {shlex.quote(rel)} && echo OK",
+                "grep -ls 'successfully booted' "
+                + " ".join(shlex.quote(f) for f in files),
                 check=False,
             )
-            if "OK" in (cp.stdout or ""):
-                pending.discard(entry)
+            for line in (cp.stdout or "").splitlines():
+                pending.discard((r, line.strip()))
         if pending:
             time.sleep(1)
     if pending and not quiet:

@@ -7,6 +7,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 from typing import Protocol
 
 from .framing import (
@@ -51,6 +52,13 @@ class Receiver:
     async def spawn(cls, address: str, handler: MessageHandler) -> "Receiver":
         self = cls(address, handler)
         host, port = parse_address(address)
+        # NARWHAL_BIND_ANY=1: listen on 0.0.0.0 with the committee port
+        # instead of the advertised IP.  Multi-host deployments need this
+        # whenever the reachable address is not on a local interface
+        # (NAT'd/cloud public IPs); the reference node rewrites its listen
+        # IP to 0.0.0.0 unconditionally (primary.rs:97-104).
+        if os.environ.get("NARWHAL_BIND_ANY") == "1":
+            host = "0.0.0.0"
         self._server = await asyncio.start_server(
             self._on_connection, host, port, limit=STREAM_LIMIT
         )

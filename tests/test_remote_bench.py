@@ -1,0 +1,28 @@
+"""Multi-host launcher smoke test: a 4-authority committee split across two
+LocalRunner "hosts" (separate workdirs, full TCP mesh between them) must
+boot, commit, and parse cleanly through the same path an SSH deployment
+uses (benchmark/remote_bench.py; reference remote.py:139-311)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmark.remote_bench import run_remote_bench  # noqa: E402
+
+
+def test_two_host_committee_commits(tmp_path):
+    result = run_remote_bench(
+        [f"local:{tmp_path}/h0", f"local:{tmp_path}/h1"],
+        nodes=4,
+        workers=1,
+        rate=2_000,
+        tx_size=512,
+        duration=8,
+        base_port=7910,
+        quiet=True,
+    )
+    assert result.errors == []
+    assert result.committed_batches > 0
+    assert result.consensus_tps > 0
+    assert result.samples > 0  # client→batch→commit join worked end-to-end
