@@ -1,0 +1,78 @@
+"""Measure the Processor's others'-batch digest cost and settle SURVEY §7
+hot-spot 3 (reference worker/src/processor.rs:35) with data.
+
+The worker hashes every batch it stores: its own batches reuse the digest
+computed at seal time in the C data plane, so the per-batch SHA-256 on the
+Python side only runs for the (N-1)/N share of traffic arriving from peer
+workers (narwhal_tpu/worker/processor.py).  This harness measures the
+host's actual SHA-256 throughput at batch granularity and converts it into
+CPU share at the driver benchmark's measured committed rate — if that share
+is small, a device/batched digest hook buys nothing and the plan item
+closes; if large, it motivates the hook.
+
+    python benchmark/digest_cost.py --tps 55000 --tx-size 512 --nodes 4 \
+        --batch-size 500000 --out artifacts/processor_digest_cost_r05.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import time
+
+
+def sha256_throughput(batch_size: int, seconds: float = 2.0) -> float:
+    """Bytes/s of hashlib.sha256 over batch-sized buffers."""
+    buf = os.urandom(batch_size)
+    n = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < seconds:
+        hashlib.sha256(buf).digest()
+        n += 1
+    return n * batch_size / (time.perf_counter() - t0)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tps", type=float, required=True,
+                    help="committed e2e tx/s from the driver bench")
+    ap.add_argument("--tx-size", type=int, default=512)
+    ap.add_argument("--nodes", type=int, default=4)
+    ap.add_argument("--batch-size", type=int, default=500_000)
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    hash_bps = sha256_throughput(args.batch_size)
+    total_bps = args.tps * args.tx_size
+    # Each worker originates T/N of the committee's committed bytes and
+    # receives every peer's batches: it hashes (N-1)/N · T per second
+    # (own batches reuse the seal-time digest from the C data plane).
+    per_worker_bps = total_bps / args.nodes * (args.nodes - 1)
+    cpu_share = per_worker_bps / hash_bps
+
+    result = {
+        "sha256_bytes_per_sec": round(hash_bps),
+        "committed_tx_per_sec": args.tps,
+        "others_batch_bytes_per_sec_per_worker": round(per_worker_bps),
+        "digest_cpu_share_per_worker": round(cpu_share, 4),
+        "decision": (
+            "close" if cpu_share < 0.02 else "implement-batched-digest-hook"
+        ),
+        "note": (
+            "own batches reuse the C data plane's seal-time digest; this is "
+            "the per-worker CPU share of hashing peers' batches at the "
+            "driver-measured committed rate (SURVEY §7 hot spot 3 "
+            "threshold: <2% closes the item)"
+        ),
+    }
+    print(json.dumps(result))
+    if args.out:
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=2)
+
+
+if __name__ == "__main__":
+    main()

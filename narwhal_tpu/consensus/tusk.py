@@ -79,15 +79,20 @@ class Tusk:
         name = self._sorted_keys[coin % len(self._sorted_keys)]
         return dag.get(round, {}).get(name)
 
+    def insert_certificate(self, certificate: Certificate) -> None:
+        """Insert into the DAG without running the commit rule.  Separate
+        seam so KernelTusk can maintain its dense device window
+        incrementally, and benchmarks can build large DAG states."""
+        self.state.dag.setdefault(certificate.round, {})[
+            certificate.origin
+        ] = (certificate.digest(), certificate)
+
     def process_certificate(self, certificate: Certificate) -> List[Certificate]:
         """Insert a certificate; return the newly committed sequence
         (possibly empty).  Reference lib.rs:105-201."""
         state = self.state
         round = certificate.round
-        state.dag.setdefault(round, {})[certificate.origin] = (
-            certificate.digest(),
-            certificate,
-        )
+        self.insert_certificate(certificate)
 
         # Order from the highest round with a 2f+1 frontier (needed to
         # reveal the common coin).  Leaders live on even rounds.

@@ -147,59 +147,8 @@ def support_stake(
     return jnp.sum(jnp.where(votes, stake, 0))
 
 
-class DagWindow:
-    """Dense tensor view of a Tusk DAG window, built from the live dict DAG.
-
-    Host-side glue: maps (round, authority) → (slot, index), resolves parent
-    digests, and hands fixed-shape arrays to the jitted scans.  Rebuilt per
-    commit attempt — O(window · N · parents) dict work, replacing up to
-    window/2 independent BFS passes of the same cost each.
-    """
-
-    def __init__(
-        self,
-        dag,  # Dag: round → {authority → (digest, certificate)}
-        names: List,  # sorted authority public keys
-        base_round: int,
-        window: int,
-    ) -> None:
-        self.names = names
-        self.index = {name: i for i, name in enumerate(names)}
-        self.base_round = base_round
-        self.window = window
-        n = len(names)
-        self.exists = np.zeros((window, n), dtype=bool)
-        self.parent = np.zeros((window, n, n), dtype=bool)
-        # digest → (slot, authority index) for every cert in the window
-        digest_pos: Dict[bytes, Tuple[int, int]] = {}
-        for r, certs in dag.items():
-            w = r - base_round
-            if 0 <= w < window:
-                for name, (digest, _) in certs.items():
-                    i = self.index[name]
-                    self.exists[w, i] = True
-                    digest_pos[bytes(digest)] = (w, i)
-        for r, certs in dag.items():
-            w = r - base_round
-            if not (1 <= w < window):
-                continue
-            for name, (_, cert) in certs.items():
-                i = self.index[name]
-                for pd in cert.header.parents:
-                    pos = digest_pos.get(bytes(pd))
-                    if pos is not None and pos[0] == w - 1:
-                        self.parent[w, i, pos[1]] = True
-
-    def slot(self, round_: int) -> int:
-        return round_ - self.base_round
-
-    def onehot(self, name) -> np.ndarray:
-        v = np.zeros(len(self.names), dtype=bool)
-        v[self.index[name]] = True
-        return v
-
-
 from ..consensus.tusk import Tusk
+from ..primary.messages import genesis
 
 
 class KernelTusk(Tusk):
@@ -209,6 +158,16 @@ class KernelTusk(Tusk):
     window traversals collapsed into one :func:`leader_chain_scan`.  The
     emission DFS (``order_dag``) stays host-side — it is O(output) and must
     produce the exact reference DFS tie-order.
+
+    The dense window (``exists[W, N]``, ``parent[W, N, N]``) is maintained
+    INCREMENTALLY as certificates arrive — O(parents) dict work per insert —
+    instead of being rebuilt from the dict DAG per commit attempt: the
+    rebuild was O(window · N · parents) of Python dict traffic and dominated
+    the kernel's end-to-end time ~1000× over the scan itself (round-5
+    artifact).  The arrays are anchored at ``last_committed_round``; commits
+    shift them down (one memmove) and pull in any certificates that arrived
+    beyond the window during a stall.  The protocol guarantees at most one
+    certificate per (round, author) — inserts never need to retract edges.
 
     The scan runs at ONE static window shape — the smallest power of two
     covering gc_depth+2 rounds, compiled once by :meth:`prewarm` — because
@@ -225,11 +184,98 @@ class KernelTusk(Tusk):
             w <<= 1
         self.max_window = w
         self.python_fallbacks = 0  # observability: stalls beyond the window
+        n = len(self._sorted_keys)
+        self._n = n
+        self._index = {name: i for i, name in enumerate(self._sorted_keys)}
+        self._win_base = 0  # round held by slot 0; == last_committed_round
+        self._exists = np.zeros((w, n), dtype=bool)
+        self._parent = np.zeros((w, n, n), dtype=bool)
+        # digest → (absolute round, authority index), all inserts ever seen
+        # in or above the window (pruned below base on shift)
+        self._digest_pos: Dict[bytes, Tuple[int, int]] = {}
+        # parent digest → [(child round, child index)]: children that
+        # arrived before their parent (edge repaired on parent insert)
+        self._waiting_child: Dict[bytes, List[Tuple[int, int]]] = {}
+        # certificates at slots ≥ window during a stall; inserted for real
+        # when a commit shifts the window down far enough
+        self._overflow: List = []
+        for cert in genesis(committee):  # State.__init__ already holds them
+            self._win_insert(cert)
+
+    # -- incremental window maintenance --------------------------------
+
+    def insert_certificate(self, certificate) -> None:
+        super().insert_certificate(certificate)
+        self._win_insert(certificate)
+
+    def process_certificate(self, certificate) -> List:
+        sequence = super().process_certificate(certificate)
+        if sequence:
+            self._win_shift()
+        return sequence
+
+    def _win_insert(self, cert) -> None:
+        r = cert.round
+        i = self._index[cert.origin]
+        self._digest_pos[bytes(cert.digest())] = (r, i)
+        w = r - self._win_base
+        if w >= self.max_window:
+            self._overflow.append(cert)
+            return
+        if w < 0:
+            return
+        self._exists[w, i] = True
+        if w >= 1:
+            for pd in cert.header.parents:
+                pos = self._digest_pos.get(bytes(pd))
+                if pos is not None and pos[0] == r - 1:
+                    self._parent[w, i, pos[1]] = True
+                else:
+                    self._waiting_child.setdefault(bytes(pd), []).append(
+                        (r, i)
+                    )
+        # Repair edges from children that arrived before this certificate.
+        for cr, ci in self._waiting_child.pop(bytes(cert.digest()), ()):
+            cw = cr - self._win_base
+            if cr == r + 1 and 0 <= cw < self.max_window:
+                self._parent[cw, ci, i] = True
+
+    def _win_shift(self) -> None:
+        new_base = max(0, self.state.last_committed_round)
+        d = new_base - self._win_base
+        if d <= 0:
+            return
+        W = self.max_window
+        if d >= W:
+            self._exists[:] = False
+            self._parent[:] = False
+        else:
+            self._exists[: W - d] = self._exists[d:]
+            self._exists[W - d :] = False
+            self._parent[: W - d] = self._parent[d:]
+            self._parent[W - d :] = False
+        self._win_base = new_base
+        # Prune host maps below the window (slot-0 certs resolve no parents).
+        self._digest_pos = {
+            k: v for k, v in self._digest_pos.items() if v[0] >= new_base
+        }
+        self._waiting_child = {
+            k: kept
+            for k, v in self._waiting_child.items()
+            if (kept := [e for e in v if e[0] > new_base])
+        }
+        # Certificates that arrived beyond the window during the stall now
+        # (possibly) fit: insert them for real.
+        overflow, self._overflow = self._overflow, []
+        for cert in overflow:
+            self._win_insert(cert)
+
+    # -- device order_leaders ------------------------------------------
 
     def prewarm(self) -> None:
         """Compile (or cache-load) the scan at its one static shape off the
         commit critical path (call at node boot)."""
-        n = len(self._sorted_keys)
+        n = self._n
         W = self.max_window
         leader_chain_scan(
             jnp.zeros((W, n, n), bool),
@@ -247,33 +293,31 @@ class KernelTusk(Tusk):
 
     def order_leaders(self, leader) -> List:
         state = self.state
-        names = self._sorted_keys
-        n = len(names)
+        n = self._n
         base = max(0, state.last_committed_round)
         span = leader.round - base + 1
         window = self.max_window
-        if span > window:
+        if span > window or base != self._win_base:
             self.python_fallbacks += 1
             return super().order_leaders(leader)
-        win = DagWindow(state.dag, names, base, window)
 
         leader_onehot = np.zeros((window, n), dtype=bool)
         is_leader_slot = np.zeros(window, dtype=bool)
-        for w in range(window):
-            r = base + w
-            if r % 2 == 0 and state.last_committed_round < r < leader.round:
-                name = self._leader_name(r)
-                if state.dag.get(r, {}).get(name) is not None:
-                    leader_onehot[w, win.index[name]] = True
-                    is_leader_slot[w] = True
+        for r in range(leader.round - 2, state.last_committed_round, -2):
+            name = self._leader_name(r)
+            if state.dag.get(r, {}).get(name) is not None:
+                leader_onehot[r - base, self._index[name]] = True
+                is_leader_slot[r - base] = True
 
+        anchor_onehot = np.zeros(n, dtype=bool)
+        anchor_onehot[self._index[leader.origin]] = True
         committed, _reach = leader_chain_scan(
-            jnp.asarray(win.parent),
-            jnp.asarray(win.exists),
+            jnp.asarray(self._parent),
+            jnp.asarray(self._exists),
             jnp.asarray(leader_onehot),
             jnp.asarray(is_leader_slot),
-            jnp.int32(win.slot(leader.round)),
-            jnp.asarray(win.onehot(leader.origin)),
+            jnp.int32(leader.round - base),
+            jnp.asarray(anchor_onehot),
             window,
         )
         committed = np.asarray(committed)

@@ -173,6 +173,78 @@ def test_multi_worker_commit(run):
     run(go())
 
 
+def test_ten_node_commit(run):
+    """N=10 committee (quorum 7): the protocol must drive rounds and commit
+    at a committee size where the 4-node fixtures hide nothing — larger
+    vote aggregation, wider broadcast fan-out, bigger parent sets
+    (BASELINE.json names 10/20/50-node configs; VERDICT r4 flagged that
+    nothing ever ran above N=4)."""
+
+    async def go():
+        n = 10
+        c = committee(base_port=14600, n=n)
+        params = Parameters(
+            header_size=32,
+            max_header_delay=200,
+            batch_size=400,
+            max_batch_delay=100,
+        )
+        commits = {i: [] for i in range(n)}
+        nodes = []
+        for i, kp in enumerate(keys(n)):
+            nodes.append(
+                await spawn_primary_node(
+                    kp,
+                    c,
+                    params,
+                    on_commit=lambda cert, i=i: commits[i].append(cert),
+                )
+            )
+            nodes.append(await spawn_worker_node(kp, 0, c, params))
+
+        host, port = parse_address(c.worker(keys(n)[0].name, 0).transactions)
+        _, w = await asyncio.open_connection(host, port)
+        txs = [bytes([1]) + i.to_bytes(8, "little") + bytes(91) for i in range(4)]
+        for tx in txs:
+            await write_frame(w, tx)
+
+        from narwhal_tpu.crypto import digest32
+        from narwhal_tpu.messages import encode_batch
+
+        expected = digest32(encode_batch(txs))
+
+        def payload_committed(certs):
+            return expected in {
+                d for cert in certs for d in cert.header.payload
+            }
+
+        # Poll budget < the run fixture's 60 s wait_for, so on failure the
+        # diagnostic AssertionError (not a bare TimeoutError) fires and the
+        # nodes still shut down.
+        for _ in range(400):
+            if all(payload_committed(v) for v in commits.values()):
+                break
+            await asyncio.sleep(0.1)
+        else:
+            raise AssertionError(
+                "payload never committed at N=10: "
+                f"{[len(v) for v in commits.values()]}"
+            )
+
+        # All ten nodes agree on the commit order.
+        seqs = [[cert.digest() for cert in commits[i]] for i in range(n)]
+        common = min(len(s) for s in seqs)
+        assert common > 0
+        for i in range(1, n):
+            assert seqs[i][:common] == seqs[0][:common]
+
+        w.close()
+        for node in nodes:
+            await node.shutdown()
+
+    run(go())
+
+
 def test_commit_with_crash_fault(run):
     """f=1 crash fault: the last node never boots (the reference's fault
     injection, benchmark/local.py:77); the 3 live nodes (2f+1 stake) must
