@@ -66,7 +66,6 @@ def build_state(tusk: Tusk, committee: Committee, span: int):
     certificate for order_leaders.  Returns (anchor, insert_seconds)."""
     names = sorted(committee.authorities.keys())
     parents = {c.digest() for c in genesis(committee)}
-    anchor = None
     t0 = time.perf_counter()
     for r in range(1, span + 1):
         nxt = set()

@@ -343,19 +343,63 @@ def run_remote_bench(
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument(
-        "--hosts", nargs="+", required=True,
+        "--settings",
+        default=None,
+        help="JSON deployment settings file (hosts + bench params); CLI "
+        "flags override it.  The analog of the reference's "
+        "benchmark/settings.json, minus the AWS-specific keys "
+        "(see benchmark/settings.example.json)",
+    )
+    ap.add_argument(
+        "--hosts", nargs="+", default=None,
         help="ssh://user@ip or local:<dir> per host",
     )
-    ap.add_argument("--nodes", type=int, default=4)
-    ap.add_argument("--workers", type=int, default=1)
-    ap.add_argument("--rate", type=int, default=20_000)
-    ap.add_argument("--tx-size", type=int, default=512)
-    ap.add_argument("--duration", type=int, default=30)
-    ap.add_argument("--base-port", type=int, default=7500)
-    ap.add_argument("--batch-size", type=int, default=500_000)
+    ap.add_argument("--nodes", type=int, default=None)
+    ap.add_argument("--workers", type=int, default=None)
+    ap.add_argument("--rate", type=int, default=None)
+    ap.add_argument("--tx-size", type=int, default=None)
+    ap.add_argument("--duration", type=int, default=None)
+    ap.add_argument("--base-port", type=int, default=None)
+    ap.add_argument("--batch-size", type=int, default=None)
     ap.add_argument("--no-install", action="store_true")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args()
+
+    settings = {}
+    if args.settings:
+        with open(args.settings) as f:
+            settings = json.load(f)
+        known = {
+            "hosts", "nodes", "workers", "rate", "tx_size", "duration",
+            "base_port", "batch_size",
+        }
+        unknown = set(settings) - known
+        if unknown:
+            # Fail loudly: a misspelled key ("tx-size", "batchsize") would
+            # otherwise silently run the bench at the default it meant to
+            # override, mislabeling the results.
+            ap.error(
+                f"unknown settings key(s) {sorted(unknown)}; "
+                f"known: {sorted(known)}"
+            )
+
+    def pick(name, default):
+        v = getattr(args, name)
+        if v is not None:
+            return v
+        return settings.get(name, default)
+
+    hosts = pick("hosts", None)
+    if not hosts:
+        ap.error("--hosts (or a settings file with \"hosts\") is required")
+    args.hosts = hosts
+    args.nodes = pick("nodes", 4)
+    args.workers = pick("workers", 1)
+    args.rate = pick("rate", 20_000)
+    args.tx_size = pick("tx_size", 512)
+    args.duration = pick("duration", 30)
+    args.base_port = pick("base_port", 7500)
+    args.batch_size = pick("batch_size", 500_000)
 
     result = run_remote_bench(
         args.hosts,

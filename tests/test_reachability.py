@@ -118,6 +118,26 @@ def test_fuzz_equivalence():
         both(order)
 
 
+def test_fuzz_equivalence_out_of_order_delivery():
+    """Children delivered BEFORE their parents: exercises KernelTusk's
+    waiting-child edge repair (a child inserted while its parent digest is
+    unknown must get its dense-window edge when the parent arrives).  Both
+    implementations see the identical delivery order, so their commit
+    sequences must still match certificate-for-certificate."""
+    rng = random.Random(0xBEEF)
+    for trial in range(6):
+        certs = _random_dag_certs(rng, rounds=rng.randint(6, 16))
+        order = list(certs)
+        # Jitter rounds by up to ~2 so a good fraction of children precede
+        # their round-(r-1) parents in delivery order.
+        order.sort(key=lambda x: x.round + rng.uniform(-2.2, 0.0))
+        assert any(
+            a.round > b.round
+            for a, b in zip(order, order[1:])
+        ), "fixture produced no out-of-order pair"
+        both(order)
+
+
 def test_causal_mask_matches_host_bfs():
     """causal_mask_scan == transitive closure of parent links (host BFS)."""
     import numpy as np
