@@ -172,6 +172,15 @@ _KILL_OURS = (
 )
 
 
+def kill_ours(runner, sig="TERM", clear_pidfile: bool = False) -> None:
+    """Kill the runner's recorded node/client pids (cmdline-verified) —
+    the one definition of the kill contract for every caller."""
+    cmd = _KILL_OURS.format(sig=sig)
+    if clear_pidfile:
+        cmd += "; rm -f pids/all"
+    runner.run(cmd, check=False)
+
+
 def run_remote_bench(
     hosts,
     nodes: int = 4,
@@ -197,7 +206,7 @@ def run_remote_bench(
     # run never reached its own cleanup — replaying its multi-GB store logs
     # would eat the next run's boot window), and create the run dirs once.
     for r in runners:
-        r.run(_KILL_OURS.format(sig=9) + "; rm -f pids/all", check=False)
+        kill_ours(r, sig=9, clear_pidfile=True)
         r.run(
             "rm -rf db-primary-* db-worker-* logs && mkdir -p logs pids",
             check=False,
@@ -308,10 +317,10 @@ def run_remote_bench(
     time.sleep(duration)
 
     for r in runners:
-        r.run(_KILL_OURS.format(sig="TERM"), check=False)
+        kill_ours(r, sig="TERM")
     time.sleep(2)
     for r in runners:
-        r.run(_KILL_OURS.format(sig=9) + "; rm -f pids/all", check=False)
+        kill_ours(r, sig=9, clear_pidfile=True)
 
     # Fetch logs (reference remote.py `_logs`) and parse with the same
     # LogParser the local bench uses.

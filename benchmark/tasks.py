@@ -1,0 +1,125 @@
+"""One task surface for the whole benchmark harness.
+
+The reference drives everything through Fabric tasks (`fab local`, `fab
+remote`, `fab plot`, `fab kill`, `fab logs` — reference
+benchmark/fabfile.py:12-135).  Same surface here as a plain argparse
+dispatcher over the existing modules:
+
+    python -m benchmark.tasks local --nodes 4 --rate 50000 --duration 25
+    python -m benchmark.tasks remote --settings benchmark/settings.example.json
+    python -m benchmark.tasks aggregate --rates 25000 56000 90000 --out s.json
+    python -m benchmark.tasks plot artifacts/sweep.json --out curve.png
+    python -m benchmark.tasks kill [--hosts ssh://... local:...]
+    python -m benchmark.tasks logs .bench --tx-size 512
+
+`install` exists as an explicit task too (remote runs it implicitly unless
+--no-install is passed).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _task_kill(argv) -> int:
+    """Kill leftover node/client processes: local ones scoped to this
+    checkout, and (with --hosts) remote ones via the runners' pid files."""
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="tasks.py kill")
+    ap.add_argument("--hosts", nargs="*", default=[])
+    args = ap.parse_args(argv)
+    from benchmark.local_bench import kill_stale_nodes
+    from benchmark.remote_bench import make_runner, kill_ours
+
+    kill_stale_nodes()
+    for spec in args.hosts:
+        kill_ours(make_runner(spec), sig=9, clear_pidfile=True)
+    print("killed stale nodes")
+    return 0
+
+
+def _task_logs(argv) -> int:
+    """Parse an existing log directory (primary-*/worker-*/client-*.log)
+    and print the summary — the reference's `fab logs`."""
+    import argparse
+    import glob
+
+    ap = argparse.ArgumentParser(prog="tasks.py logs")
+    ap.add_argument("logdir")
+    ap.add_argument("--tx-size", type=int, default=512)
+    args = ap.parse_args(argv)
+    from benchmark.logs import parse_logs
+
+    read = lambda pat: [  # noqa: E731
+        open(p).read() for p in sorted(glob.glob(os.path.join(args.logdir, pat)))
+    ]
+    clients, workers, primaries = (
+        read("client-*.log"), read("worker-*.log"), read("primary-*.log"),
+    )
+    if not (clients or workers or primaries):
+        # A typo'd directory must not read as a successful parse of a run
+        # that committed nothing.
+        print(f"no *-N.log files found in {args.logdir!r}", file=sys.stderr)
+        return 2
+    result = parse_logs(clients, workers, primaries, args.tx_size)
+    if result.errors:
+        print("ERRORS detected in logs:", file=sys.stderr)
+        for e in result.errors[:10]:
+            print("  " + e, file=sys.stderr)
+    print(result.summary(0, args.tx_size, 0, 0))
+    return 1 if result.errors else 0
+
+
+def _task_install(argv) -> int:
+    """rsync this checkout to each ssh:// host and build its native lib."""
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="tasks.py install")
+    ap.add_argument("--hosts", nargs="+", required=True)
+    args = ap.parse_args(argv)
+    from benchmark.remote_bench import make_runner
+
+    for spec in args.hosts:
+        make_runner(spec).install()
+        print(f"installed on {spec}")
+    return 0
+
+
+def main() -> int:
+    tasks = {
+        "local": lambda argv: _delegate("benchmark.local_bench", argv),
+        "remote": lambda argv: _delegate("benchmark.remote_bench", argv),
+        "aggregate": lambda argv: _delegate("benchmark.aggregate", argv),
+        "plot": lambda argv: _delegate("benchmark.plot", argv),
+        "kill": _task_kill,
+        "logs": _task_logs,
+        "install": _task_install,
+    }
+    if len(sys.argv) < 2 or sys.argv[1] in ("-h", "--help"):
+        print(__doc__)
+        print("tasks:", ", ".join(sorted(tasks)))
+        return 0
+    name, argv = sys.argv[1], sys.argv[2:]
+    if name not in tasks:
+        print(f"unknown task {name!r}; tasks: {', '.join(sorted(tasks))}",
+              file=sys.stderr)
+        return 2
+    return tasks[name](argv) or 0
+
+
+def _delegate(module: str, argv) -> int:
+    import importlib
+
+    mod = importlib.import_module(module)
+    sys.argv = [module] + list(argv)
+    rc = mod.main()
+    return rc or 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
