@@ -1,0 +1,152 @@
+"""Measure whether GF(2^255-19) limb layout limits the ed25519 kernel.
+
+field25519 stores an element as int32[B, 32] (limbs minor).  On the v5e VPU
+the minor axis maps to the 128-lane dimension; 32 limbs (63 for the raw
+convolution) fill at most half a lane word, so the shifted-MAC convolution
+may be running at ~50% lane utilization.  The candidate fix — limbs-major
+int32[63, B] with the batch on the lane axis — is a cross-cutting refactor
+of every field/point op, so this probe measures the core loop both ways
+first: a jitted chain of K dependent field multiplies (conv + fold + carry,
+the exact op mix of mul()) per layout, timed via result fetch (the tunnel's
+~69 ms fetch floor is reported separately and subtracted; see
+artifacts/consensus_bench_r05.json for the floor methodology).
+
+    python benchmark/field_layout_probe.py --batch 8192 --chain 256 \
+        --out artifacts/field_layout_probe_r05.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from functools import partial
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+BITS, LIMBS, MASK, FOLD = 8, 32, 255, 38
+
+
+def _mul_limbs_minor(a, b):
+    """field25519.mul's exact structure: [..., 32] limbs on the minor axis."""
+    import jax.numpy as jnp
+
+    conv = jnp.zeros(a.shape[:-1] + (2 * LIMBS - 1,), jnp.int32)
+    pad_base = [(0, 0)] * (b.ndim - 1)
+    for i in range(LIMBS):
+        conv = conv + a[..., i : i + 1] * jnp.pad(
+            b, pad_base + [(i, LIMBS - 1 - i)]
+        )
+    hi, lo = conv[..., LIMBS:], conv[..., :LIMBS]
+    c = lo.at[..., : LIMBS - 1].add(hi * FOLD)
+    for _ in range(4):
+        h = c >> BITS
+        c = (c & MASK).at[..., 1:].add(h[..., :-1])
+        c = c.at[..., 0].add(h[..., -1] * FOLD)
+    return c
+
+
+def _mul_limbs_major(a, b):
+    """Same math with limbs on the MAJOR axis: [63|32, B] — batch spans the
+    128-lane dimension fully when B % 128 == 0."""
+    import jax.numpy as jnp
+
+    conv = jnp.zeros((2 * LIMBS - 1,) + a.shape[1:], jnp.int32)
+    for i in range(LIMBS):
+        conv = conv.at[i : i + LIMBS].add(a[i][None, :] * b)
+    hi, lo = conv[LIMBS:], conv[:LIMBS]
+    c = lo.at[: LIMBS - 1].add(hi * FOLD)
+    for _ in range(4):
+        h = c >> BITS
+        c = (c & MASK).at[1:].add(h[:-1])
+        c = c.at[0].add(h[-1] * FOLD)
+    return c
+
+
+def _chain(mul, k):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    @jax.jit
+    def run(a, b):
+        def step(c, _):
+            return mul(c, b), None
+
+        c, _ = lax.scan(step, a, None, length=k)
+        return c
+
+    return run
+
+
+def _time_fetch(fn, args, reps):
+    np.asarray(fn(*args))  # warm/compile
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        np.asarray(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    return sorted(ts)[len(ts) // 2]
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=8192)
+    ap.add_argument("--chain", type=int, default=256)
+    ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    a = rng.integers(0, 256, (args.batch, LIMBS), dtype=np.int32)
+    b = rng.integers(0, 256, (args.batch, LIMBS), dtype=np.int32)
+
+    # Fetch floor: trivial jitted compute + fetch.
+    f = jax.jit(lambda x: x + 1)
+    x = jnp.zeros(8, jnp.int32)
+    floor = _time_fetch(f, (x,), args.reps)
+
+    minor = _chain(_mul_limbs_minor, args.chain)
+    t_minor = _time_fetch(minor, (jnp.asarray(a), jnp.asarray(b)), args.reps)
+
+    major = _chain(_mul_limbs_major, args.chain)
+    t_major = _time_fetch(
+        major, (jnp.asarray(a.T.copy()), jnp.asarray(b.T.copy())), args.reps
+    )
+
+    # Cross-check the layouts agree.
+    got_minor = np.asarray(minor(jnp.asarray(a), jnp.asarray(b)))
+    got_major = np.asarray(
+        major(jnp.asarray(a.T.copy()), jnp.asarray(b.T.copy()))
+    ).T
+    assert (got_minor == got_major).all(), "layouts disagree"
+
+    per_mul = lambda t: (t - floor) / args.chain * 1e6  # noqa: E731
+    result = {
+        "device": str(jax.devices()[0]),
+        "batch": args.batch,
+        "chain_muls": args.chain,
+        "fetch_floor_ms": round(floor * 1e3, 2),
+        "limbs_minor_us_per_batched_mul": round(per_mul(t_minor), 2),
+        "limbs_major_us_per_batched_mul": round(per_mul(t_major), 2),
+        "major_over_minor_speedup": round(
+            (t_minor - floor) / max(t_major - floor, 1e-9), 2
+        ),
+    }
+    print(json.dumps(result))
+    if args.out:
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as f_:
+            json.dump(result, f_, indent=2)
+
+
+if __name__ == "__main__":
+    main()
