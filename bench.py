@@ -69,6 +69,36 @@ def main() -> None:
     if os.environ.get("BENCH_CRYPTO", "1") == "1":
         import subprocess
 
+        # Cheap device probe first: a wedged tunnel (e.g. a chip grant lost
+        # to a killed client) makes jax.devices() hang, and the crypto
+        # microbench would eat its whole 540 s timeout discovering that.
+        # NEVER SIGKILL the probe (subprocess.run's timeout would): killing
+        # a child mid-chip-claim is itself what wedges the grant.  SIGTERM
+        # and, if it still won't die, leave it to finish claiming and exit
+        # on its own — crypto is skipped either way.
+        probe = subprocess.Popen(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        try:
+            device_ok = probe.wait(timeout=90) == 0
+        except subprocess.TimeoutExpired:
+            device_ok = False
+            probe.terminate()
+            try:
+                probe.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                pass
+        if not device_ok:
+            print(
+                "WARNING: TPU device probe failed/hung; skipping crypto "
+                "microbench",
+                file=sys.stderr,
+            )
+    else:
+        device_ok = False
+    if device_ok:
         try:
             out = subprocess.run(
                 [

@@ -22,7 +22,6 @@ import json
 import os
 import sys
 import time
-from functools import partial
 
 import numpy as np
 
@@ -33,7 +32,9 @@ BITS, LIMBS, MASK, FOLD = 8, 32, 255, 38
 
 
 def _mul_limbs_minor(a, b):
-    """field25519.mul's exact structure: [..., 32] limbs on the minor axis."""
+    """The PRE-refactor layout, reproduced verbatim for the A/B: limbs on
+    the minor axis, [..., 32] (what field25519.mul was before the
+    limbs-major conversion this probe motivated)."""
     import jax.numpy as jnp
 
     conv = jnp.zeros(a.shape[:-1] + (2 * LIMBS - 1,), jnp.int32)
@@ -52,20 +53,11 @@ def _mul_limbs_minor(a, b):
 
 
 def _mul_limbs_major(a, b):
-    """Same math with limbs on the MAJOR axis: [63|32, B] — batch spans the
-    128-lane dimension fully when B % 128 == 0."""
-    import jax.numpy as jnp
+    """The live limbs-major implementation — measure the real code, not a
+    copy that could drift."""
+    from narwhal_tpu.ops import field25519 as F
 
-    conv = jnp.zeros((2 * LIMBS - 1,) + a.shape[1:], jnp.int32)
-    for i in range(LIMBS):
-        conv = conv.at[i : i + LIMBS].add(a[i][None, :] * b)
-    hi, lo = conv[LIMBS:], conv[:LIMBS]
-    c = lo.at[: LIMBS - 1].add(hi * FOLD)
-    for _ in range(4):
-        h = c >> BITS
-        c = (c & MASK).at[1:].add(h[:-1])
-        c = c.at[0].add(h[-1] * FOLD)
-    return c
+    return F.mul(a, b)
 
 
 def _chain(mul, k):
