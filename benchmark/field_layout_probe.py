@@ -1,15 +1,16 @@
-"""Measure whether GF(2^255-19) limb layout limits the ed25519 kernel.
+"""A/B the GF(2^255-19) limb layouts that decided the limbs-major refactor.
 
-field25519 stores an element as int32[B, 32] (limbs minor).  On the v5e VPU
-the minor axis maps to the 128-lane dimension; 32 limbs (63 for the raw
-convolution) fill at most half a lane word, so the shifted-MAC convolution
-may be running at ~50% lane utilization.  The candidate fix — limbs-major
-int32[63, B] with the batch on the lane axis — is a cross-cutting refactor
-of every field/point op, so this probe measures the core loop both ways
-first: a jitted chain of K dependent field multiplies (conv + fold + carry,
-the exact op mix of mul()) per layout, timed via result fetch (the tunnel's
-~69 ms fetch floor is reported separately and subtracted; see
-artifacts/consensus_bench_r05.json for the floor methodology).
+field25519 now stores an element limbs-major, int32[32, ...] with the batch
+on the minor axis: XLA maps the minor-most axis to the v5e VPU's 128-lane
+dimension, and the previous limbs-minor int32[B, 32] layout filled at most
+63 of 128 lanes during the convolution.  This probe measures both layouts —
+the live field25519.mul vs a verbatim copy of the pre-refactor minor-layout
+mul — as a jitted chain of K dependent field multiplies, timed via result
+fetch (the tunnel's ~69 ms fetch floor is reported separately and
+subtracted; see artifacts/consensus_bench_r05.json for the floor
+methodology).  It produced the evidence for the refactor (CPU backend:
+~4-5× for the mul chain, 78→390 verifies/s for the full kernel) and reruns
+on the chip to record the device-side number.
 
     python benchmark/field_layout_probe.py --batch 8192 --chain 256 \
         --out artifacts/field_layout_probe_r05.json

@@ -31,7 +31,6 @@ from __future__ import annotations
 
 import hashlib
 import os
-from functools import partial
 from typing import List, Sequence, Tuple
 
 import numpy as np
