@@ -8,7 +8,11 @@ analog: a host is anything a `Runner` can reach — `ssh://user@ip` for real
 clusters (install = rsync of this checkout, no AWS dependency) or
 `local:<dir>` subprocess sandboxes, which give a faithful 2+-"host" run
 (separate working dirs, separate stores, full TCP mesh) on one machine and
-are what the test suite exercises.
+are what the test suite exercises.  A cloud-instance lifecycle module
+(instance.py's boto3 create/start/stop/terminate) is deliberately out of
+scope: it is provider-specific and needs egress; the Runner protocol is the
+seam where one would plug in — provision however you like, hand this file
+ssh targets.
 
     python benchmark/remote_bench.py --hosts ssh://10.0.0.1 ssh://10.0.0.2 \
         --rate 40000 --duration 30
