@@ -51,6 +51,48 @@ def test_sharded_commit_step_matches_unsharded():
         np.testing.assert_array_equal(np.asarray(r), np.asarray(g))
 
 
+def test_sharded_verify_batch_matches_unsharded():
+    """The ed25519 batch verifier data-parallel over the mesh: the batch
+    axis sharded across 8 devices must produce the same accept/reject mask
+    as the single-device run — the multi-chip scaling story for the
+    per-round crypto (one chip per primary today; batch-sharded chips per
+    primary is the same program with a different mesh)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from narwhal_tpu.crypto import KeyPair
+    from narwhal_tpu.crypto.digest import Digest
+    from narwhal_tpu.ops import ed25519 as E
+
+    n_devices = 8
+    assert len(jax.devices()) >= n_devices, (
+        "conftest must provision the 8-device CPU mesh"
+    )
+    batch = 16  # one pad shape: divisible by the mesh, tiny for CPU compile
+    kp = KeyPair.generate(b"\x07" * 32)
+    msgs, keys, sigs = [], [], []
+    for i in range(batch):
+        m = bytes(Digest(bytes([i]) * 32))
+        msgs.append(m)
+        keys.append(kp.name)
+        sigs.append(kp.sign(Digest(m)))
+    sigs[3] = type(sigs[3])(bytes(64))  # one forgery: mask must reject it
+
+    args = E.prepare_batch(msgs, keys, sigs, batch)
+    ref = np.asarray(E._verify_kernel(*(jnp.asarray(a) for a in args)))
+    assert ref.tolist() == [i != 3 for i in range(batch)]
+
+    mesh = Mesh(np.array(jax.devices()[:n_devices]), ("batch",))
+    # Every per-signature array is sharded on its batch axis (axis 0 for
+    # all of prepare_batch's outputs).
+    sharded = [
+        jax.device_put(jnp.asarray(a), NamedSharding(mesh, P("batch")))
+        for a in args
+    ]
+    with mesh:
+        got = np.asarray(E._verify_kernel(*sharded))
+    np.testing.assert_array_equal(ref, got)
+
+
 def test_dryrun_multichip_subprocess_green():
     """The actual driver hook must run green end-to-end (it self-provisions
     a CPU mesh in a subprocess, so it works regardless of this process's
