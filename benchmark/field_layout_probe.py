@@ -1,16 +1,25 @@
-"""A/B the GF(2^255-19) limb layouts that decided the limbs-major refactor.
+"""A/B the GF(2^255-19) limb layouts — the probe that mis-predicted, kept
+as the cautionary record.
 
-field25519 now stores an element limbs-major, int32[32, ...] with the batch
-on the minor axis: XLA maps the minor-most axis to the v5e VPU's 128-lane
-dimension, and the previous limbs-minor int32[B, 32] layout filled at most
-63 of 128 lanes during the convolution.  This probe measures both layouts —
-the live field25519.mul vs a verbatim copy of the pre-refactor minor-layout
-mul — as a jitted chain of K dependent field multiplies, timed via result
-fetch (the tunnel's ~69 ms fetch floor is reported separately and
-subtracted; see artifacts/consensus_bench_r05.json for the floor
-methodology).  It produced the evidence for the refactor (CPU backend:
-~4-5× for the mul chain, 78→390 verifies/s for the full kernel) and reruns
-on the chip to record the device-side number.
+field25519 stores an element limbs-MINOR, int32[..., 32] with the limb
+axis on the VPU lane dimension.  A mid-round-5 refactor flipped it to
+limbs-major int32[32, B] on this probe's CPU-backend evidence (~4-5× for
+the mul chain, 78→390 verifies/s for the full kernel): with the batch
+minor-most every lane does useful work, where limbs-minor fills only 63 of
+128 lanes during the convolution.  The real chip then measured the full
+verify kernel 2× SLOWER limbs-major (artifacts/crypto_bench_r05*.json:
+168 → 317 ms/2048-batch; a [32, B/128, 128] batch-blocked variant
+recovered only to 211 ms).  Lane occupancy is not the binding constraint
+on v5e — locality is: limbs-minor keeps a field element's entire 63-limb
+convolution row inside one (8, 128) tile, so the 32 shifted accumulates
+stay register-resident, while any limbs-major variant spreads one element
+across 32+ tiles and pays tile traffic per accumulate.  The CPU backend
+rewards exactly the opposite (contiguous batch vectorization), which is
+why it was a bad proxy.  field25519 was restored to limbs-minor; this
+probe now measures the live limbs-minor mul against a verbatim copy of
+the limbs-major one, as a jitted chain of K dependent field multiplies,
+timed via result fetch (the tunnel's ~69 ms fetch floor is reported
+separately and subtracted).
 
     python benchmark/field_layout_probe.py --batch 8192 --chain 256 \
         --out artifacts/field_layout_probe_r05.json
@@ -33,9 +42,9 @@ BITS, LIMBS, MASK, FOLD = 8, 32, 255, 38
 
 
 def _mul_limbs_minor(a, b):
-    """The PRE-refactor layout, reproduced verbatim for the A/B: limbs on
-    the minor axis, [..., 32] (what field25519.mul was before the
-    limbs-major conversion this probe motivated)."""
+    """The LIVE layout (kept as a verbatim inline copy so the probe's two
+    arms stay symmetric): limbs on the minor axis, [..., 32] — what
+    field25519.mul is."""
     import jax.numpy as jnp
 
     conv = jnp.zeros(a.shape[:-1] + (2 * LIMBS - 1,), jnp.int32)
@@ -54,11 +63,21 @@ def _mul_limbs_minor(a, b):
 
 
 def _mul_limbs_major(a, b):
-    """The live limbs-major implementation — measure the real code, not a
-    copy that could drift."""
-    from narwhal_tpu.ops import field25519 as F
+    """The abandoned limbs-major layout, reproduced verbatim from the
+    reverted refactor: element is [32, batch...], each convolution term a
+    scalar-slice times the whole operand at limb offset i."""
+    import jax.numpy as jnp
 
-    return F.mul(a, b)
+    conv = jnp.zeros((2 * LIMBS - 1,) + a.shape[1:], jnp.int32)
+    for i in range(LIMBS):
+        conv = conv.at[i : i + LIMBS].add(a[i][None] * b)
+    hi, lo = conv[LIMBS:], conv[:LIMBS]
+    c = lo.at[: LIMBS - 1].add(hi * FOLD)
+    for _ in range(4):
+        h = c >> BITS
+        c = (c & MASK).at[1:].add(h[:-1])
+        c = c.at[0].add(h[-1] * FOLD)
+    return c
 
 
 def _chain(mul, k):

@@ -63,8 +63,11 @@ def kill_stale_nodes() -> None:
     testbed (reference benchmark/benchmark/local.py:26-29).  Stale nodes
     squat on ports and burn CPU, silently corrupting the next measurement.
     Scoped by process cwd == this repo, so concurrent harnesses in other
-    checkouts are left alone."""
+    checkouts are left alone.  SIGTERM with a grace period, not SIGKILL:
+    a stale node may hold the device, and killing a chip-holder wedges
+    the grant server-side (see the teardown comment in run_bench)."""
     me = os.getpid()
+    stale = []
     for pid_s in os.listdir("/proc"):
         if not pid_s.isdigit() or int(pid_s) == me:
             continue
@@ -76,9 +79,25 @@ def kill_stale_nodes() -> None:
                 continue
             if os.readlink(f"/proc/{pid_s}/cwd") != REPO:
                 continue
-            os.kill(int(pid_s), signal.SIGKILL)
+            os.kill(int(pid_s), signal.SIGTERM)
+            stale.append(int(pid_s))
         except OSError:
             continue
+    # Same 75 s grace as run_bench's teardown: a stale node may be
+    # mid-device-call, and its graceful release can take that long.
+    deadline = time.time() + 75
+    for pid in stale:
+        while time.time() < deadline:
+            try:
+                os.kill(pid, 0)
+            except OSError:
+                break  # gone
+            time.sleep(0.2)
+        else:
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except OSError:
+                pass
 
 
 def run_bench(
@@ -150,12 +169,12 @@ def run_bench(
     procs = []
     primary_logs, worker_logs, client_logs = [], [], []
 
-    def spawn(cmd, logfile, env=cpu_env):
+    def spawn(cmd, logfile, env=cpu_env, tpu=False):
         f = open(logfile, "w")
         p = subprocess.Popen(
             cmd, stdout=f, stderr=subprocess.STDOUT, env=env, cwd=REPO
         )
-        procs.append((p, f))
+        procs.append((p, f, tpu))
         return p
 
     # Device-requiring flags go only to the TPU-designated primaries; any
@@ -171,6 +190,28 @@ def run_bench(
 
     alive = nodes - faults  # crash faults: the last `faults` nodes never boot
     any_tpu = bool(device_flags)
+    # Populate the persistent XLA cache BEFORE spawning the committee: a
+    # cold-cache node spends minutes compiling warmup shapes over the
+    # tunnel — it misses the boot deadline, the run measures a committee
+    # without it, and tearing it down mid-compile wedges the chip grant
+    # server-side (observed: jax.devices() hung for hours afterwards).
+    # The prewarm subprocess compiles the exact same shapes (shared
+    # derive_max_claims sizing), is never killed, and makes the node's own
+    # warmup a cache load.
+    if any_tpu:
+        if not quiet:
+            print("Prewarming device kernels...", file=sys.stderr)
+        warm_cmd = [
+            sys.executable,
+            "-m",
+            "narwhal_tpu.node",
+            "prewarm",
+            "--committee",
+            f"{workdir}/committee.json",
+        ]
+        if consensus_kernel:
+            warm_cmd.append("--consensus-kernel")
+        subprocess.run(warm_cmd, env=tpu_env, cwd=REPO, check=False)
     for i in range(alive):
         on_tpu = any_tpu and (tpu_primaries is None or i < tpu_primaries)
         log = f"{workdir}/primary-{i}.log"
@@ -196,6 +237,7 @@ def run_bench(
             ],
             log,
             env=tpu_env if on_tpu else cpu_env,
+            tpu=on_tpu,
         )
         for wid in range(workers):
             log = f"{workdir}/worker-{i}-{wid}.log"
@@ -276,13 +318,19 @@ def run_bench(
     time.sleep(duration)
 
     # SIGTERM first (lets NARWHAL_PROFILE dumps flush), then SIGKILL.
-    for p, f in procs:
+    # Chip-holding children get a much longer grace period: SIGKILLing a
+    # process mid-device-call wedges the chip grant server-side (the
+    # tunnel's jax.devices() then hangs for hours) — the graceful SIGTERM
+    # path releases the claim.
+    for p, f, tpu in procs:
         try:
             p.send_signal(signal.SIGTERM)
         except ProcessLookupError:
             pass
-    deadline = time.time() + 3
-    for p, f in procs:
+    cpu_deadline = time.time() + 3
+    tpu_deadline = time.time() + 75
+    for p, f, tpu in procs:
+        deadline = tpu_deadline if tpu else cpu_deadline
         try:
             p.wait(timeout=max(0.1, deadline - time.time()))
         except subprocess.TimeoutExpired:

@@ -67,10 +67,48 @@ def main(argv=None) -> int:
     wrk = runsub.add_parser("worker", help="Run a single worker")
     wrk.add_argument("--id", type=int, required=True)
 
+    warm = sub.add_parser(
+        "prewarm",
+        help="Compile the device kernels for a committee's shapes into the "
+        "persistent XLA cache, then exit.  Run this once before launching "
+        "TPU-flagged nodes: their boot-time warmup then loads from cache "
+        "in seconds instead of compiling for minutes (and a bench harness "
+        "never has to kill a node mid-compile — see the verify-skill "
+        "gotcha about wedged chip grants).",
+    )
+    warm.add_argument("--committee", required=True)
+    warm.add_argument("--consensus-kernel", action="store_true", default=False)
+    warm.add_argument("--gc-depth", type=int, default=None)
+
     args = parser.parse_args(argv)
 
     if args.command == "generate_keys":
         export_keypair(KeyPair.generate(), args.filename)
+        return 0
+
+    if args.command == "prewarm":
+        setup_logging(args.verbosity)
+        log = logging.getLogger("narwhal.node")
+        committee = Committee.load(args.committee)
+        from ..crypto import backend as crypto_backend
+        from .node import derive_max_claims
+
+        crypto_backend.set_backend("tpu")
+        backend = crypto_backend.get_backend()
+        log.info("Prewarming tpu verify backend...")
+        backend.warmup(max_claims=derive_max_claims(committee))
+        log.info("Verify backend ready")
+        if args.consensus_kernel:
+            from ..ops.reachability import KernelTusk
+
+            gc_depth = (
+                args.gc_depth
+                if args.gc_depth is not None
+                else Parameters().gc_depth
+            )
+            log.info("Prewarming consensus kernel...")
+            KernelTusk(committee, gc_depth).prewarm()
+            log.info("Consensus kernel ready")
         return 0
 
     setup_logging(args.verbosity)

@@ -25,6 +25,25 @@ log = logging.getLogger("narwhal.node")
 CHANNEL_CAPACITY = 1_000
 
 
+def derive_max_claims(committee: Committee) -> int:
+    """Largest claim batch a Core burst can produce: DRAIN_LIMIT items,
+    each a certificate carrying its header claim plus one quorum of vote
+    claims.  Worst case is the LARGEST vote set that can form a quorum
+    (smallest stakes first), not the smallest.  Shared between node boot
+    and the bench harness's device pre-warm step so both compile exactly
+    the same pad shapes."""
+    from ..primary.core import Core
+
+    stakes = sorted(a.stake for a in committee.authorities.values())
+    acc, worst_votes = 0, 0
+    for s in stakes:
+        acc += s
+        worst_votes += 1
+        if acc >= committee.quorum_threshold():
+            break
+    return Core.DRAIN_LIMIT * (worst_votes + 1)
+
+
 class PrimaryNode:
     def __init__(self) -> None:
         self.primary: Optional[Primary] = None
@@ -65,22 +84,10 @@ async def spawn_primary_node(
 
     backend = crypto_backend.get_backend()
     if hasattr(backend, "warmup"):
-        from ..primary.core import Core
-
-        # Largest claim batch a Core burst can produce: DRAIN_LIMIT items,
-        # each a certificate carrying its header claim plus one quorum of
-        # vote claims — warm every pad shape up to it so no live burst hits
-        # XLA compile.  Worst case is the LARGEST vote set that can form a
-        # quorum (smallest stakes first), not the smallest.
-        stakes = sorted(a.stake for a in committee.authorities.values())
-        acc, worst_votes = 0, 0
-        for s in stakes:
-            acc += s
-            worst_votes += 1
-            if acc >= committee.quorum_threshold():
-                break
+        # Warm every pad shape up to the worst-case burst so no live burst
+        # hits XLA compile (sizing rationale in derive_max_claims).
         log.info("Warming up %s verify backend...", backend.name)
-        backend.warmup(max_claims=Core.DRAIN_LIMIT * (worst_votes + 1))
+        backend.warmup(max_claims=derive_max_claims(committee))
         log.info("Verify backend %s ready", backend.name)
 
     tx_new_certificates = asyncio.Queue(maxsize=CHANNEL_CAPACITY)

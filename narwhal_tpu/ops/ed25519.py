@@ -4,11 +4,10 @@ The reference's per-round crypto hot loop is `Signature::verify_batch`
 (crypto/src/lib.rs:206-219), called with 2f+1 signatures per certificate ×
 N certificates per round (primary/src/messages.rs:189-215).  Its dalek
 backend runs 51-bit-limb u128 arithmetic on the CPU; here the same batch
-maps to TPU vector lanes: field elements are 32×8-bit int32 limbs stored
-limbs-major with the batch on the lane axis (ops/field25519.py), points
-are extended twisted-Edwards coordinates (X:Y:Z:T), and the double-scalar
-ladder [s]B + [k](-A) runs one shared MSB-first windowed Horner loop for
-the whole batch.
+maps to TPU vector lanes: field elements are 32×8-bit int32 limbs
+(ops/field25519.py), points are extended twisted-Edwards coordinates
+(X:Y:Z:T), and the double-scalar ladder [s]B + [k](-A) runs one shared
+MSB-first windowed Horner loop for the whole batch.
 
 Verification semantics (strict, a superset of RFC 8032 rejections —
 deviations from specific CPU libraries are *more* rejections, never fewer):
@@ -53,17 +52,16 @@ _ONE = jnp.asarray(F.to_limbs(1))
 _ZERO = jnp.asarray(F.to_limbs(0))
 
 # --------------------------------------------------------------- point ops
-# A point is a tuple (X, Y, Z, T) of limbs-major int32[LIMBS, ...] with
-# x=X/Z, y=Y/Z, T = XY/Z (extended homogeneous coordinates;
-# Hisil–Wong–Carter–Dawson).  The batch axis is minor-most so the field
-# ops' convolutions keep every VPU lane busy (see field25519 docstring).
+# A point is a tuple (X, Y, Z, T) of int32[..., LIMBS=32] with x=X/Z,
+# y=Y/Z, T = XY/Z (extended homogeneous coords; Hisil–Wong–Carter–Dawson).
 
 Point = Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]
 
 
 def identity_like(x: jnp.ndarray) -> Point:
-    zero = F.bcast(_ZERO, x)
-    one = F.bcast(_ONE, x)
+    shape = x.shape[:-1] + (F.LIMBS,)
+    zero = jnp.broadcast_to(_ZERO, shape)
+    one = jnp.broadcast_to(_ONE, shape)
     return (zero, one, one, zero)
 
 
@@ -75,7 +73,7 @@ def point_add(p: Point, q: Point) -> Point:
     x2, y2, z2, t2 = q
     a = F.mul(F.sub(y1, x1), F.sub(y2, x2))
     b = F.mul(F.add(y1, x1), F.add(y2, x2))
-    c = F.mul(F.mul(t1, F.bcast(_2D, t1)), t2)
+    c = F.mul(F.mul(t1, _2D), t2)
     d = F.mul(F.add(z1, z1), z2)
     e = F.sub(b, a)
     f = F.sub(d, c)
@@ -89,7 +87,8 @@ def point_double(p: Point) -> Point:
     x1, y1, z1, _ = p
     a = F.square(x1)
     b = F.square(y1)
-    c = F.mul_small(F.square(z1), 2)
+    zz = F.square(z1)
+    c = F.add(zz, zz)  # 2·z² via the 1-sweep add (mul_small carries 4×)
     h = F.add(a, b)
     e = F.sub(h, F.square(F.add(x1, y1)))
     g = F.sub(a, b)
@@ -139,8 +138,9 @@ def decompress(y_limbs: jnp.ndarray, sign: jnp.ndarray,
     """
     y = y_limbs
     yy = F.square(y)
-    u = F.sub(yy, F.bcast(_ONE, y))
-    v = F.add(F.mul(yy, F.bcast(_D, y)), F.bcast(_ONE, y))
+    u = F.sub(yy, jnp.broadcast_to(_ONE, y.shape))
+    v = F.add(F.mul(yy, jnp.broadcast_to(_D, y.shape)),
+              jnp.broadcast_to(_ONE, y.shape))
     # x = u·v³·(u·v⁷)^((p-5)/8)  (RFC 8032 §5.1.3)
     v3 = F.mul(F.square(v), v)
     v7 = F.mul(F.square(v3), v)
@@ -148,16 +148,17 @@ def decompress(y_limbs: jnp.ndarray, sign: jnp.ndarray,
     vxx = F.mul(v, F.square(x))
     ok_direct = F.eq(vxx, u)
     ok_twist = F.eq(vxx, F.neg(u))
-    x = F.select(ok_direct, x, F.mul(x, F.bcast(_SQRT_M1, x)))
+    x = F.select(ok_direct, x,
+                 F.mul(x, jnp.broadcast_to(_SQRT_M1, x.shape)))
     on_curve = ok_direct | ok_twist
     xc = F.canon(x)
-    x_is_zero = jnp.all(xc == 0, axis=0)
+    x_is_zero = jnp.all(xc == 0, axis=-1)
     # x = 0 with sign = 1 is invalid; otherwise flip x to match the sign.
     sign_ok = ~(x_is_zero & (sign == 1))
-    flip = (xc[0] & 1) != sign
+    flip = (xc[..., 0] & 1) != sign
     x = F.select(flip, F.neg(xc), xc)
     valid = on_curve & sign_ok & y_canonical
-    point = (x, y, F.bcast(_ONE, y), F.mul(x, y))
+    point = (x, y, jnp.broadcast_to(_ONE, y.shape), F.mul(x, y))
     return point, valid
 
 
@@ -197,25 +198,24 @@ _B_TABLE = jnp.asarray(_B_TABLE_NP)  # [16, 4, LIMBS]: j·B in extended coords
 
 
 def _select_from_table(table: jnp.ndarray, w: jnp.ndarray) -> Point:
-    """One-hot window select: table [16, 4, LIMBS, B] (per-item rows) or
-    constant [16, 4, LIMBS], w int32[B] in [0, 16) → Point with
-    limbs-major [LIMBS, B] coordinates."""
-    onehot = jax.nn.one_hot(w, 16, dtype=jnp.int32)  # [B, 16]
+    """One-hot window select: table [..., 16, 4, LIMBS] (or constant
+    [16, 4, LIMBS]), w int32[...] in [0, 16) → Point at w."""
+    onehot = jax.nn.one_hot(w, 16, dtype=jnp.int32)  # [..., 16]
     if table.ndim == 3:
-        sel = jnp.einsum("bj,jcl->clb", onehot, table)
+        sel = jnp.einsum("...j,jcl->...cl", onehot, table)
     else:
-        sel = jnp.einsum("bj,jclb->clb", onehot, table)
-    return (sel[0], sel[1], sel[2], sel[3])
+        sel = jnp.einsum("...j,...jcl->...cl", onehot, table)
+    return (sel[..., 0, :], sel[..., 1, :], sel[..., 2, :], sel[..., 3, :])
 
 
 def _build_neg_a_table(neg_a: Point) -> jnp.ndarray:
-    """[16, 4, LIMBS, B]: j·(-A) for j in 0..15 (15 sequential adds)."""
+    """[..., 16, 4, LIMBS]: j·(-A) for j in 0..15 (15 sequential adds)."""
     rows: List[Point] = [identity_like(neg_a[0])]
     for _ in range(15):
         rows.append(point_add(rows[-1], neg_a))
     stacked = jnp.stack(
-        [jnp.stack(r, axis=0) for r in rows], axis=0
-    )  # [16, 4, LIMBS, B]
+        [jnp.stack(r, axis=-2) for r in rows], axis=-3
+    )  # [..., 16, 4, LIMBS]
     return stacked
 
 
@@ -234,16 +234,12 @@ def _verify_kernel(
     s_ok: jnp.ndarray,      # bool[B] — S < L
     k_windows: jnp.ndarray,  # int32[B, 64] MSB-first windows of k mod L
 ) -> jnp.ndarray:
-    # Host prep hands batch-major [B, LIMBS] arrays; the kernel works
-    # limbs-major [LIMBS, B] (batch on the VPU lane axis) — one transpose
-    # at entry, fused into the first op's layout by XLA.
-    a_yt, r_yt = a_y.T, r_y.T
-    a_point, a_valid = decompress(a_yt, a_sign, a_canon)
-    r_point, r_valid = decompress(r_yt, r_sign, r_canon)
+    a_point, a_valid = decompress(a_y, a_sign, a_canon)
+    r_point, r_valid = decompress(r_y, r_sign, r_canon)
     small = is_small_order(a_point) | is_small_order(r_point)
 
     neg_a = point_neg(a_point)
-    a_table = _build_neg_a_table(neg_a)  # [16, 4, LIMBS, B]
+    a_table = _build_neg_a_table(neg_a)  # [B, 16, 4, LIMBS]
 
     def step(i, acc):
         acc = point_double(point_double(point_double(point_double(acc))))
@@ -251,7 +247,7 @@ def _verify_kernel(
         acc = point_add(acc, _select_from_table(a_table, k_windows[:, i]))
         return acc
 
-    start = identity_like(a_yt)
+    start = identity_like(a_y)
     result = jax.lax.fori_loop(0, 64, step, start)
 
     return a_valid & r_valid & ~small & s_ok & point_eq(result, r_point)

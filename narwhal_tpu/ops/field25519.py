@@ -1,4 +1,4 @@
-"""GF(2^255 - 19) arithmetic from 32-bit integer lanes, limbs-major.
+"""GF(2^255 - 19) arithmetic from 32-bit integer lanes, batch-first.
 
 TPU has no native 64-bit multiply, so field elements are 32 limbs of 8
 bits (radix 2^8) held in int32.  The radix keeps every intermediate
@@ -11,16 +11,10 @@ the TPU-shaped answer to the reference's ed25519-dalek
 (crypto/src/lib.rs:206-219), whose Rust backend uses 51-bit limbs in
 u128 — a layout that cannot map to vector lanes.
 
-All functions are LIMBS-MAJOR: an element is ``int32[32, ...]`` with the
-limb axis FIRST and batch axes trailing; limb i (bits [8i, 8i+8)) lives
-at index ``[i, ...]``.  Rationale: XLA maps the minor-most axis to the
-128-lane vector dimension, so the earlier limbs-minor ``[..., 32]``
-layout filled at most 63 of 128 lanes during the convolution while the
-batch axis sat on sublanes; with the batch minor-most every lane does
-useful work (measured ~5× on the CPU backend, see
-benchmark/field_layout_probe.py).  Outputs of mul/add/sub are *weakly
-reduced* (limbs < 2^9 — see carry(); value possibly ≥ p); ``canon``
-fully reduces into [0, p) with limbs < 2^8.
+All functions are batch-first: an element is ``int32[..., 32]`` and every
+op vmaps/broadcasts over leading axes.  Limb i holds bits [8i, 8i+8).
+Outputs of mul/add/sub are *weakly reduced* (limbs < 2^9 — see carry();
+value possibly ≥ p); ``canon`` fully reduces into [0, p) with limbs < 2^8.
 
 Correctness strategy: every op is differential-tested against Python big
 ints over random + boundary values (tests/test_field25519.py), and every
@@ -55,33 +49,28 @@ def from_limbs(limbs) -> int:
     return sum(int(v) << (BITS * i) for i, v in enumerate(arr))
 
 
-def bcast(const: jnp.ndarray, like: jnp.ndarray) -> jnp.ndarray:
-    """Broadcast a [LIMBS] constant against a limbs-major [LIMBS, ...]
-    element (jnp broadcasting aligns trailing axes, which would pair the
-    constant's limb axis with the batch axis — expand explicitly)."""
-    return jnp.broadcast_to(
-        const.reshape((LIMBS,) + (1,) * (like.ndim - 1)), like.shape
-    )
-
-
 def _carry_once(c: jnp.ndarray) -> jnp.ndarray:
     """One vectorized carry sweep; the carry out of the top limb wraps to
     limb 0 multiplied by 38 (2^256 ≡ 38 mod p)."""
     hi = c >> BITS
     lo = c & MASK
-    out = lo.at[1:].add(hi[:-1])
-    return out.at[0].add(hi[-1] * FOLD)
+    out = lo.at[..., 1:].add(hi[..., :-1])
+    return out.at[..., 0].add(hi[..., -1] * FOLD)
 
 
-def carry(c: jnp.ndarray) -> jnp.ndarray:
+def carry(c: jnp.ndarray, sweeps: int = 4) -> jnp.ndarray:
     """Propagate carries until every limb is weakly reduced: **< 2^9**
     (NOT < 2^8 — the final sweep can both leave a limb at 255 + carry-in
     and add the ×38 top-limb wrap to limb 0, so limb 0 reaches up to
-    255 + 38 = 293).  Input limbs may be up to 2^31; the sweep bounds are
-    ≤ 255 + 2^23, ≤ 255 + 2^15, ≤ 255 + 2^7, then < 2^9.  Every consumer
-    is dimensioned for the 2^9 weak bound (see mul's exactness note and
-    sub's ZP offset)."""
-    for _ in range(4):
+    255 + 38 = 293).  With the default 4 sweeps, input limbs may be up to
+    2^31: the sweep bounds are ≤ 255 + 2^23, ≤ 255 + 2^15, ≤ 255 + 2^7,
+    then < 2^9.  Every consumer is dimensioned for the 2^9 weak bound
+    (see mul's exactness note and sub's ZP offset).
+
+    ``sweeps`` lets callers with tighter input bounds skip work (each
+    sweep is ~5 vector ops on the hot path); every reduced-sweep call
+    site must carry its own bound proof (see add/sub)."""
+    for _ in range(sweeps):
         c = _carry_once(c)
     return c
 
@@ -95,60 +84,47 @@ def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     products are < 2^18 and a convolution row accumulates ≤ 32 of them →
     < 2^23, far inside int32.
 
-    Limbs-major: each term is a [batch...] scalar-slice times the whole
-    [32, batch...] operand accumulated at limb offset i — the batch axis
-    stays minor-most, so every VPU lane is busy at any batch ≥ 128.
-
     Why not the MXU?  The "one-hot convolution tensor" formulation — a
     single [B·32², 63] f32 matmul — was measured 1.4× SLOWER end-to-end
     on v5e: it must materialize the [B, 32²] outer product through HBM
     (66 MB round trip per multiply at B=8192) and its useful-FLOP ratio
     is 1/63, while the shifted-MAC chain fuses into one VPU kernel whose
     only HBM traffic is the operands and the result."""
-    # Both operands must carry the same number of batch axes: a bare
-    # [LIMBS] constant against [LIMBS, B] would pair its limb axis with
-    # the batch axis under trailing-align broadcasting (silently wrong at
-    # B == LIMBS) — route constants through bcast() first.
-    assert a.ndim == b.ndim, (a.shape, b.shape)
-    batch_shape = jnp.broadcast_shapes(a.shape[1:], b.shape[1:])
-    conv = jnp.zeros((2 * LIMBS - 1,) + batch_shape, jnp.int32)
+    shape = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
+    conv = jnp.zeros(shape + (2 * LIMBS - 1,), jnp.int32)
+    pad_base = [(0, 0)] * (b.ndim - 1)
     for i in range(LIMBS):
-        conv = conv.at[i : i + LIMBS].add(a[i][None] * b)
+        conv = conv + a[..., i : i + 1] * jnp.pad(
+            b, pad_base + [(i, LIMBS - 1 - i)]
+        )
     # Fold limbs ≥ 32: 2^(8(32+j)) ≡ 38·2^(8j) (mod p); conv < 2^23 so the
     # ×38 (< 2^29) stays inside int32.
-    hi = conv[LIMBS:]
-    lo = conv[:LIMBS]
-    folded = lo.at[: LIMBS - 1].add(hi * FOLD)
+    hi = conv[..., LIMBS:]
+    lo = conv[..., :LIMBS]
+    folded = lo.at[..., : LIMBS - 1].add(hi * FOLD)
     return carry(folded)
 
 
 def square(a: jnp.ndarray) -> jnp.ndarray:
-    """Field square, weakly reduced output — symmetry-specialized:
-    c[k] = Σ_{i<j, i+j=k} 2·a_i·a_j + (k even: a_{k/2}²), so each row
-    accumulates ≤ 16 doubled cross terms instead of 32, halving the MAC
-    lane work (measured 1.4× vs mul(a, a); squares are ~60% of the verify
-    ladder's multiplies — 4 per point double plus the ~500 squarings of
-    the two decompression exponentiations).
-
-    Exactness: weak limbs < 2^9 (carry()'s contract) → doubled limbs
-    < 2^10 → products < 2^19; a row sums ≤ 16 of them plus one diagonal
-    < 2^18 → < 2^23.1, the same budget as mul's convolution (fold ×38
-    keeps it < 2^29)."""
-    a2 = a + a
-    batch_shape = a.shape[1:]
-    conv = jnp.zeros((2 * LIMBS - 1,) + batch_shape, jnp.int32)
-    for i in range(LIMBS):
-        conv = conv.at[2 * i].add(a[i] * a[i])
-        if i + 1 < LIMBS:
-            conv = conv.at[2 * i + 1 : i + LIMBS].add(a[i][None] * a2[i + 1 :])
-    hi = conv[LIMBS:]
-    lo = conv[:LIMBS]
-    folded = lo.at[: LIMBS - 1].add(hi * FOLD)
-    return carry(folded)
+    """Deliberately just mul(a, a): the symmetry-specialized square
+    (≤16 doubled cross terms per convolution row instead of 32) was a
+    measured 1.4× win ONLY in the abandoned limbs-major layout, where the
+    accumulate slices ran along the compute-mapped sublane axis and
+    shorter slices meant fewer tile ops.  Here the limb axis sits on
+    lanes: every shifted-accumulate row is one full-width vector op
+    whether half its entries are zero or not, so halving the *terms*
+    saves no *ops* — the specialization buys nothing and costs an extra
+    concatenate per row (see benchmark/field_layout_probe.py for the
+    layout story)."""
+    return mul(a, a)
 
 
 def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    return carry(a + b)
+    """a + b (mod p), weakly reduced.  One carry sweep suffices: both
+    operands are weak (< 2^9), so the sum is < 2^10, the per-limb carry
+    out is ≤ 3, and after one sweep limbs 1..31 are ≤ 255 + 3 and limb 0
+    is ≤ 255 + 3·38 = 369 — all < 2^9."""
+    return carry(a + b, sweeps=1)
 
 
 # Borrow-free subtraction needs a limb vector ZP whose value is ≡ 0 (mod p)
@@ -167,12 +143,19 @@ _ZP = jnp.asarray(np.array(_zp, dtype=np.int32))
 
 
 def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """a - b (mod p): the ZP offset keeps every limb non-negative."""
-    return carry(a + bcast(_ZP, a) - b)
+    """a - b (mod p): the ZP offset keeps every limb non-negative.
+
+    Two carry sweeps suffice: a + ZP - b < 2^9 + 2^15 = 33280 per limb,
+    so sweep 1's carries are ≤ 130, leaving limbs 1..31 ≤ 255 + 130 and
+    limb 0 ≤ 255 + 130·38 = 5195; sweep 2's carries are then ≤ 20
+    (limb 0) / ≤ 1 (rest), leaving limb 1 ≤ 275, limbs 2..31 ≤ 256, and
+    limb 0 ≤ 255 + 1·38 = 293 — all < 2^9."""
+    return carry(a + _ZP - b, sweeps=2)
 
 
 def neg(a: jnp.ndarray) -> jnp.ndarray:
-    return carry(bcast(_ZP, a) - a)
+    """-a (mod p); same bound argument as sub (a ≤ ZP + 2^9 per limb)."""
+    return carry(_ZP - a, sweeps=2)
 
 
 def mul_small(a: jnp.ndarray, k: int) -> jnp.ndarray:
@@ -225,7 +208,8 @@ _P_LIMBS = jnp.asarray(to_limbs(P))
 def _sub_p(c: jnp.ndarray):
     """(c - p) with full borrow propagation.  Returns (limbs, underflow):
     underflow True means c < p (result invalid, keep c)."""
-    d = c - bcast(_P_LIMBS, c)
+    d = c - _P_LIMBS
+    d_first = jnp.moveaxis(d, -1, 0)  # [LIMBS, ...]
 
     def step(borrow, d_i):
         v = d_i - borrow
@@ -233,9 +217,9 @@ def _sub_p(c: jnp.ndarray):
         v = v + jnp.where(neg_, jnp.int32(1 << BITS), jnp.int32(0))
         return jnp.where(neg_, jnp.int32(1), jnp.int32(0)), v
 
-    borrow0 = jnp.zeros(c.shape[1:], dtype=jnp.int32)
-    borrow, limbs = jax.lax.scan(step, borrow0, d)
-    return limbs, borrow > 0
+    borrow0 = jnp.zeros(c.shape[:-1], dtype=jnp.int32)
+    borrow, limbs = jax.lax.scan(step, borrow0, d_first)
+    return jnp.moveaxis(limbs, 0, -1), borrow > 0
 
 
 def canon(a: jnp.ndarray) -> jnp.ndarray:
@@ -251,18 +235,18 @@ def canon(a: jnp.ndarray) -> jnp.ndarray:
     # subtraction until below p (3 rounds give margin).
     for _ in range(3):
         d, under = _sub_p(c)
-        c = jnp.where(under[None], c, d)
+        c = jnp.where(under[..., None], c, d)
     return c
 
 
 def is_zero(a: jnp.ndarray) -> jnp.ndarray:
-    return jnp.all(canon(a) == 0, axis=0)
+    return jnp.all(canon(a) == 0, axis=-1)
 
 
 def eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    return jnp.all(canon(a) == canon(b), axis=0)
+    return jnp.all(canon(a) == canon(b), axis=-1)
 
 
 def select(cond: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
-    """cond ? a : b, with cond shaped [...] and a/b [LIMBS, ...]."""
-    return jnp.where(cond[None], a, b)
+    """cond ? a : b, with cond shaped [...] and a/b [..., LIMBS]."""
+    return jnp.where(cond[..., None], a, b)

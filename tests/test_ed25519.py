@@ -180,26 +180,25 @@ def test_point_ops_match_python_reference():
         x2, y2 = E._ref_scalarmult(k2)
         xs, ys = E._ref_scalarmult(k1 + k2)
         xd, yd = E._ref_scalarmult(2 * k1)
-        # Limbs-major single-element batch: [32, 1].
         p1 = (
-            jnp.asarray(F.to_limbs(x1))[:, None],
-            jnp.asarray(F.to_limbs(y1))[:, None],
-            jnp.asarray(F.to_limbs(1))[:, None],
-            jnp.asarray(F.to_limbs((x1 * y1) % F.P))[:, None],
+            jnp.asarray(F.to_limbs(x1))[None],
+            jnp.asarray(F.to_limbs(y1))[None],
+            jnp.asarray(F.to_limbs(1))[None],
+            jnp.asarray(F.to_limbs((x1 * y1) % F.P))[None],
         )
         p2 = (
-            jnp.asarray(F.to_limbs(x2))[:, None],
-            jnp.asarray(F.to_limbs(y2))[:, None],
-            jnp.asarray(F.to_limbs(1))[:, None],
-            jnp.asarray(F.to_limbs((x2 * y2) % F.P))[:, None],
+            jnp.asarray(F.to_limbs(x2))[None],
+            jnp.asarray(F.to_limbs(y2))[None],
+            jnp.asarray(F.to_limbs(1))[None],
+            jnp.asarray(F.to_limbs((x2 * y2) % F.P))[None],
         )
         ps = E.point_add(p1, p2)
         pd = E.point_double(p1)
         for point, (ex, ey) in ((ps, (xs, ys)), (pd, (xd, yd))):
-            zinv = pow(F.from_limbs(np.asarray(F.canon(point[2]))[:, 0]),
+            zinv = pow(F.from_limbs(np.asarray(F.canon(point[2]))[0]),
                        F.P - 2, F.P)
-            gx = (F.from_limbs(np.asarray(F.canon(point[0]))[:, 0]) * zinv) % F.P
-            gy = (F.from_limbs(np.asarray(F.canon(point[1]))[:, 0]) * zinv) % F.P
+            gx = (F.from_limbs(np.asarray(F.canon(point[0]))[0]) * zinv) % F.P
+            gy = (F.from_limbs(np.asarray(F.canon(point[1]))[0]) * zinv) % F.P
             assert (gx, gy) == (ex, ey)
 
 

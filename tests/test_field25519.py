@@ -22,14 +22,12 @@ def rand_elems(n):
 
 
 def batch(vals):
-    # Limbs-major [32, N]: limb axis first, batch on the minor axis
-    # (field25519 docstring).
-    return jnp.asarray(np.stack([F.to_limbs(v) for v in vals], axis=1))
+    return jnp.asarray(np.stack([F.to_limbs(v) for v in vals]))
 
 
 def test_roundtrip():
     vals = rand_elems(32)
-    got = [F.from_limbs(x) for x in np.asarray(batch(vals)).T]
+    got = [F.from_limbs(x) for x in np.asarray(batch(vals))]
     assert got == vals
 
 
@@ -40,9 +38,9 @@ def test_add_sub_neg():
     d = np.asarray(F.canon(F.sub(a, b)))
     n = np.asarray(F.canon(F.neg(a)))
     for i, (x, y) in enumerate(zip(a_vals, b_vals)):
-        assert F.from_limbs(s[:, i]) == (x + y) % P
-        assert F.from_limbs(d[:, i]) == (x - y) % P
-        assert F.from_limbs(n[:, i]) == (-x) % P
+        assert F.from_limbs(s[i]) == (x + y) % P
+        assert F.from_limbs(d[i]) == (x - y) % P
+        assert F.from_limbs(n[i]) == (-x) % P
 
 
 def test_mul_square():
@@ -51,8 +49,8 @@ def test_mul_square():
     m = np.asarray(F.canon(F.mul(a, b)))
     sq = np.asarray(F.canon(F.square(a)))
     for i, (x, y) in enumerate(zip(a_vals, b_vals)):
-        assert F.from_limbs(m[:, i]) == (x * y) % P, f"mul row {i}"
-        assert F.from_limbs(sq[:, i]) == (x * x) % P, f"sq row {i}"
+        assert F.from_limbs(m[i]) == (x * y) % P, f"mul row {i}"
+        assert F.from_limbs(sq[i]) == (x * x) % P, f"sq row {i}"
 
 
 def test_mul_chain_stays_reduced():
@@ -67,7 +65,7 @@ def test_mul_chain_stays_reduced():
         expect = [(e * x) % P for e, x in zip(expect, a_vals)]
     got = np.asarray(F.canon(acc))
     for i, e in enumerate(expect):
-        assert F.from_limbs(got[:, i]) == e
+        assert F.from_limbs(got[i]) == e
 
 
 def test_invert():
@@ -75,7 +73,7 @@ def test_invert():
     a = batch(vals)
     inv = np.asarray(F.canon(F.invert(a)))
     for i, v in enumerate(vals):
-        assert F.from_limbs(inv[:, i]) == pow(v, P - 2, P)
+        assert F.from_limbs(inv[i]) == pow(v, P - 2, P)
 
 
 def test_pow_p58():
@@ -84,17 +82,17 @@ def test_pow_p58():
     r = np.asarray(F.canon(F.pow_p58(a)))
     e = (P - 5) // 8
     for i, v in enumerate(vals):
-        assert F.from_limbs(r[:, i]) == pow(v, e, P)
+        assert F.from_limbs(r[i]) == pow(v, e, P)
 
 
 def test_canon_and_eq():
     # p and 0 are the same element; 2^255-19+x ≡ x.
     a = batch([P, 0, P + 5, 5])
     c = np.asarray(F.canon(a))
-    assert F.from_limbs(c[:, 0]) == 0 and F.from_limbs(c[:, 2]) == 5
-    assert bool(F.eq(a[:, 0], a[:, 1])) and bool(F.eq(a[:, 2], a[:, 3]))
-    assert not bool(F.eq(a[:, 1], a[:, 3]))
-    assert bool(F.is_zero(a[:, 0])) and not bool(F.is_zero(a[:, 3]))
+    assert F.from_limbs(c[0]) == 0 and F.from_limbs(c[2]) == 5
+    assert bool(F.eq(a[0], a[1])) and bool(F.eq(a[2], a[3]))
+    assert not bool(F.eq(a[1], a[3]))
+    assert bool(F.is_zero(a[0])) and not bool(F.is_zero(a[3]))
 
 
 def test_mul_small():
@@ -102,4 +100,4 @@ def test_mul_small():
     a = batch(vals)
     r = np.asarray(F.canon(F.mul_small(a, 121666)))
     for i, v in enumerate(vals):
-        assert F.from_limbs(r[:, i]) == (v * 121666) % P
+        assert F.from_limbs(r[i]) == (v * 121666) % P
