@@ -105,7 +105,7 @@ def main() -> None:
                     sys.executable,
                     os.path.join(REPO, "bench_crypto.py"),
                     "--batches",
-                    "8192",
+                    "16384",
                     "--iters",
                     "3",
                     "--cpu-budget",

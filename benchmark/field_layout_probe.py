@@ -7,9 +7,11 @@ limbs-major int32[32, B] on this probe's CPU-backend evidence (~4-5× for
 the mul chain, 78→390 verifies/s for the full kernel): with the batch
 minor-most every lane does useful work, where limbs-minor fills only 63 of
 128 lanes during the convolution.  The real chip then measured the full
-verify kernel 2× SLOWER limbs-major (artifacts/crypto_bench_r05*.json:
-168 → 317 ms/2048-batch; a [32, B/128, 128] batch-blocked variant
-recovered only to 211 ms).  Lane occupancy is not the binding constraint
+verify kernel 2× SLOWER limbs-major (168 → 317 ms/2048-batch; a
+[32, B/128, 128] batch-blocked variant recovered only to 211 ms — both
+runs recorded in artifacts/crypto_bench_r05_limbs_major.json, the
+restored-layout run in artifacts/crypto_bench_r05.json).  Lane occupancy
+is not the binding constraint
 on v5e — locality is: limbs-minor keeps a field element's entire 63-limb
 convolution row inside one (8, 128) tile, so the 32 shifted accumulates
 stay register-resident, while any limbs-major variant spreads one element

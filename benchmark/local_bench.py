@@ -211,7 +211,21 @@ def run_bench(
         ]
         if consensus_kernel:
             warm_cmd.append("--consensus-kernel")
-        subprocess.run(warm_cmd, env=tpu_env, cwd=REPO, check=False)
+        if crypto_backend != "tpu":
+            # Consensus-kernel-only run: the nodes keep CPU crypto, so
+            # compiling the verify shapes would be pure waste.
+            warm_cmd.append("--skip-verify")
+        warm = subprocess.run(warm_cmd, env=tpu_env, cwd=REPO, check=False)
+        if warm.returncode != 0:
+            # Loud but non-fatal: the nodes will still try to boot (their
+            # own warmup compiles cold), and the boot-deadline wait below
+            # plus the parser's error hard-fail surface the consequences.
+            print(
+                "WARNING: device prewarm exited "
+                f"{warm.returncode}; TPU nodes will compile cold and may "
+                "miss the boot deadline",
+                file=sys.stderr,
+            )
     for i in range(alive):
         on_tpu = any_tpu and (tpu_primaries is None or i < tpu_primaries)
         log = f"{workdir}/primary-{i}.log"

@@ -79,6 +79,14 @@ def main(argv=None) -> int:
     warm.add_argument("--committee", required=True)
     warm.add_argument("--consensus-kernel", action="store_true", default=False)
     warm.add_argument("--gc-depth", type=int, default=None)
+    warm.add_argument(
+        "--skip-verify",
+        action="store_true",
+        default=False,
+        help="Skip the verify-kernel warmup (e.g. consensus-kernel-only "
+        "runs keep CPU crypto and never touch that cache; each cold "
+        "verify shape costs minutes of compile over a tunnel)",
+    )
 
     args = parser.parse_args(argv)
 
@@ -90,14 +98,15 @@ def main(argv=None) -> int:
         setup_logging(args.verbosity)
         log = logging.getLogger("narwhal.node")
         committee = Committee.load(args.committee)
-        from ..crypto import backend as crypto_backend
-        from .node import derive_max_claims
+        if not args.skip_verify:
+            from ..crypto import backend as crypto_backend
+            from .node import derive_max_claims
 
-        crypto_backend.set_backend("tpu")
-        backend = crypto_backend.get_backend()
-        log.info("Prewarming tpu verify backend...")
-        backend.warmup(max_claims=derive_max_claims(committee))
-        log.info("Verify backend ready")
+            crypto_backend.set_backend("tpu")
+            backend = crypto_backend.get_backend()
+            log.info("Prewarming tpu verify backend...")
+            backend.warmup(max_claims=derive_max_claims(committee))
+            log.info("Verify backend ready")
         if args.consensus_kernel:
             from ..ops.reachability import KernelTusk
 
