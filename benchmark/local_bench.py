@@ -34,25 +34,29 @@ from narwhal_tpu.crypto import KeyPair  # noqa: E402
 from benchmark.logs import parse_logs  # noqa: E402
 
 
-def build_committee(keypairs, base_port, workers, ips=None):
+def build_committee(keypairs, base_port, workers, ips=None, worker_ips=None):
     """Sequential port allocation, one block of 2+3W ports per authority
     (reference config.py:63-86).  ``ips`` optionally maps authority index →
-    IP for multi-host committees; default is all-loopback."""
+    IP for multi-host committees; ``worker_ips[i][wid]`` additionally puts
+    authority i's worker wid on its own host (the reference's
+    ``collocate=False`` placement, remote.py:108-130) — default is the
+    authority IP for every role, all-loopback if ``ips`` is unset."""
     port = base_port
     auths = {}
     for i, kp in enumerate(keypairs):
-        ip = ips[i] if ips else "127.0.0.1"
+        primary_ip = ips[i] if ips else "127.0.0.1"
 
-        def nxt():
+        def nxt(ip):
             nonlocal port
             a = f"{ip}:{port}"
             port += 1
             return a
 
-        primary = PrimaryAddresses(nxt(), nxt())
-        ws = {
-            wid: WorkerAddresses(nxt(), nxt(), nxt()) for wid in range(workers)
-        }
+        primary = PrimaryAddresses(nxt(primary_ip), nxt(primary_ip))
+        ws = {}
+        for wid in range(workers):
+            wip = worker_ips[i][wid] if worker_ips else primary_ip
+            ws[wid] = WorkerAddresses(nxt(wip), nxt(wip), nxt(wip))
         auths[kp.name] = Authority(stake=1, primary=primary, workers=ws)
     return Committee(auths)
 

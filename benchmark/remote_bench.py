@@ -21,7 +21,13 @@ ssh targets.
 
 Each authority i runs (primary + workers + its clients) on host i%H; the
 committee file carries each host's address, so all inter-authority traffic
-crosses the real network between hosts.
+crosses the real network between hosts.  ``--no-collocate`` instead spreads
+each authority's roles round-robin (the reference's ``collocate=False``
+control-plane/data-plane machine split, remote.py:108-130): given at least
+1+W hosts per authority-role-set, its primary and every worker land on
+different hosts and the primary↔worker LAN hop also crosses the network
+(with fewer hosts the round-robin wraps and a warning says which part of
+that claim still holds).
 """
 
 from __future__ import annotations
@@ -200,8 +206,37 @@ def run_remote_bench(
     install: bool = True,
     keep_logs: bool = False,
     quiet: bool = False,
+    collocate: bool = True,
 ):
     runners = [make_runner(h) for h in hosts]
+    # Role→host placement.  Collocated (default): authority i's primary,
+    # workers and clients all on host i%H — the reference's default.  Non-
+    # collocated (reference remote.py:108-130, `collocate=False`): each
+    # authority's roles spread round-robin over the host list — the
+    # control-plane/data-plane machine split that lets payload bandwidth
+    # scale independently of the primary (SURVEY §2.3.2).  Every role of
+    # one authority lands on a distinct host iff 1+workers ≤ H; with
+    # fewer hosts the round-robin wraps and some worker shares its
+    # primary's host (warned below — those hops are loopback, and
+    # published numbers should say so).
+    n_hosts = len(runners)
+    if collocate:
+        p_host = lambda i: runners[i % n_hosts]  # noqa: E731
+        w_host = lambda i, w: runners[i % n_hosts]  # noqa: E731
+    else:
+        stride = 1 + workers
+        p_host = lambda i: runners[(i * stride) % n_hosts]  # noqa: E731
+        w_host = (  # noqa: E731
+            lambda i, w: runners[(i * stride + 1 + w) % n_hosts]
+        )
+        if stride > n_hosts and not quiet:
+            print(
+                f"WARNING: --no-collocate with {workers} worker(s) needs "
+                f"{stride} hosts per authority for fully split roles but "
+                f"only {n_hosts} are available; some primary-worker hops "
+                "stay on one host",
+                file=sys.stderr,
+            )
     if install:
         for r in runners:
             r.install()
@@ -225,7 +260,10 @@ def run_remote_bench(
         keypairs,
         base_port,
         workers,
-        ips=[runners[i % len(runners)].ip for i in range(nodes)],
+        ips=[p_host(i).ip for i in range(nodes)],
+        worker_ips=[
+            [w_host(i, w).ip for w in range(workers)] for i in range(nodes)
+        ],
     )
     committee.export(f"{stage}/committee.json")
     Parameters(
@@ -243,14 +281,13 @@ def run_remote_bench(
         r.put(f"{stage}/committee.json", "configs/committee.json")
         r.put(f"{stage}/parameters.json", "configs/parameters.json")
     for i in range(nodes):
-        runners[i % len(runners)].put(
-            f"{stage}/node-{i}.json", f"configs/node-{i}.json"
-        )
+        # Every host running one of authority i's roles needs its keypair.
+        for r in {p_host(i)} | {w_host(i, w) for w in range(workers)}:
+            r.put(f"{stage}/node-{i}.json", f"configs/node-{i}.json")
 
     # Launch primaries and workers, then clients (reference remote.py:213-271).
     primary_logs, worker_logs, client_logs = [], [], []
     for i in range(nodes):
-        r = runners[i % len(runners)]
         common = [
             "-m", "narwhal_tpu.node", "run",
             "--keys", f"configs/node-{i}.json",
@@ -258,6 +295,7 @@ def run_remote_bench(
             "--parameters", "configs/parameters.json",
             "--benchmark",
         ]
+        r = p_host(i)
         primary_logs.append((r, f"logs/primary-{i}.log"))
         _spawn_cmd(
             r,
@@ -265,9 +303,10 @@ def run_remote_bench(
             f"logs/primary-{i}.log",
         )
         for w in range(workers):
-            worker_logs.append((r, f"logs/worker-{i}-{w}.log"))
+            rw = w_host(i, w)
+            worker_logs.append((rw, f"logs/worker-{i}-{w}.log"))
             _spawn_cmd(
-                r,
+                rw,
                 common + ["--store", f"db-worker-{i}-{w}", "worker", "--id", str(w)],
                 f"logs/worker-{i}-{w}.log",
             )
@@ -299,8 +338,10 @@ def run_remote_bench(
     rate_share = max(1, rate // max(1, nodes * workers))
     idx = 0
     for i in range(nodes):
-        r = runners[i % len(runners)]
         for w in range(workers):
+            # Clients live with the worker they feed (reference
+            # remote.py:226-237 runs clients on the worker's instance).
+            r = w_host(i, w)
             addr = committee.worker(keypairs[i].name, w).transactions
             client_logs.append((r, f"logs/client-{i}-{w}.log"))
             _spawn_cmd(
@@ -375,6 +416,13 @@ def main() -> None:
     ap.add_argument("--base-port", type=int, default=None)
     ap.add_argument("--batch-size", type=int, default=None)
     ap.add_argument("--no-install", action="store_true")
+    ap.add_argument(
+        "--no-collocate",
+        action="store_true",
+        help="Place each authority's primary and workers on different "
+        "hosts (reference collocate=False, remote.py:108-130) instead of "
+        "packing an authority per host",
+    )
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args()
 
@@ -424,6 +472,7 @@ def main() -> None:
         base_port=args.base_port,
         batch_size=args.batch_size,
         install=not args.no_install,
+        collocate=not args.no_collocate,
     )
     if result.errors:
         print("ERRORS detected in logs:", file=sys.stderr)

@@ -26,3 +26,28 @@ def test_two_host_committee_commits(tmp_path):
     assert result.committed_batches > 0
     assert result.consensus_tps > 0
     assert result.samples > 0  # client→batch→commit join worked end-to-end
+
+
+def test_non_collocated_placement_commits(tmp_path):
+    """collocate=False: each authority's primary and worker land on
+    different "hosts" (reference remote.py:108-130); the primary↔worker
+    hop crosses host boundaries and the committee still commits client
+    payloads end-to-end."""
+    hosts = [f"local:{tmp_path}/h{j}" for j in range(2)]
+    result = run_remote_bench(
+        hosts,
+        nodes=4,
+        workers=1,
+        rate=2_000,
+        tx_size=512,
+        # A slightly longer window than the collocated test above: commits
+        # must additionally cross the host boundary on the primary↔worker
+        # hop, and on a shared-core CI host an 8 s window has flaked.
+        duration=12,
+        base_port=7960,
+        quiet=True,
+        collocate=False,
+    )
+    assert result.errors == []
+    assert result.committed_batches > 0
+    assert result.samples > 0
