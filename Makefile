@@ -18,6 +18,13 @@ native:
 test:
 	$(PYTHON) -m pytest tests/ -x -q
 
+# The crypto differential suite under the float32 lane dtype (the default
+# run covers int32 + a narrow f32 subprocess check; run this after any
+# change to narwhal_tpu/ops/field25519.py or ed25519.py).
+test-f32:
+	NARWHAL_FIELD_DTYPE=float32 $(PYTHON) -m pytest \
+		tests/test_field25519.py tests/test_ed25519.py -x -q
+
 bench: native
 	$(PYTHON) bench.py
 

@@ -154,8 +154,11 @@ def decompress(y_limbs: jnp.ndarray, sign: jnp.ndarray,
     xc = F.canon(x)
     x_is_zero = jnp.all(xc == 0, axis=-1)
     # x = 0 with sign = 1 is invalid; otherwise flip x to match the sign.
+    # Parity via % 2, not & 1: exact in both lane dtypes (f32 mod of an
+    # exact integer < 2^24 is exact), and the comparison against the
+    # int32 sign bit promotes losslessly.
     sign_ok = ~(x_is_zero & (sign == 1))
-    flip = (xc[..., 0] & 1) != sign
+    flip = (xc[..., 0] % 2) != sign
     x = F.select(flip, F.neg(xc), xc)
     valid = on_curve & sign_ok & y_canonical
     point = (x, y, jnp.broadcast_to(_ONE, y.shape), F.mul(x, y))
@@ -187,7 +190,7 @@ def _ref_scalarmult(k: int) -> Tuple[int, int]:
     return q
 
 
-_B_TABLE_NP = np.zeros((16, 4, F.LIMBS), dtype=np.int32)
+_B_TABLE_NP = np.zeros((16, 4, F.LIMBS), dtype=F.NP_DTYPE)
 for _j in range(16):
     _x, _y = _ref_scalarmult(_j)
     _B_TABLE_NP[_j, 0] = F.to_limbs(_x)
@@ -199,12 +202,16 @@ _B_TABLE = jnp.asarray(_B_TABLE_NP)  # [16, 4, LIMBS]: j·B in extended coords
 
 def _select_from_table(table: jnp.ndarray, w: jnp.ndarray) -> Point:
     """One-hot window select: table [..., 16, 4, LIMBS] (or constant
-    [16, 4, LIMBS]), w int32[...] in [0, 16) → Point at w."""
-    onehot = jax.nn.one_hot(w, 16, dtype=jnp.int32)  # [..., 16]
-    if table.ndim == 3:
-        sel = jnp.einsum("...j,jcl->...cl", onehot, table)
-    else:
-        sel = jnp.einsum("...j,...jcl->...cl", onehot, table)
+    [16, 4, LIMBS]), w int32[...] in [0, 16) → Point at w.
+
+    Explicit broadcast-multiply + sum, NOT einsum: a dot_general would be
+    eligible for the MXU, whose f32 matmuls run as bf16 passes — limbs
+    reach 2^9, past bf16's 8-bit mantissa, so that path could silently
+    round in float32 lane mode.  The elementwise form stays on the VPU
+    and is exact in both dtypes (products are limb·{0,1})."""
+    onehot = jax.nn.one_hot(w, 16, dtype=F.DTYPE)  # [..., 16]
+    oh = onehot[..., :, None, None]  # [..., 16, 1, 1]
+    sel = (oh * table).sum(axis=-3)  # [..., 4, LIMBS]
     return (sel[..., 0, :], sel[..., 1, :], sel[..., 2, :], sel[..., 3, :])
 
 
@@ -234,6 +241,10 @@ def _verify_kernel(
     s_ok: jnp.ndarray,      # bool[B] — S < L
     k_windows: jnp.ndarray,  # int32[B, 64] MSB-first windows of k mod L
 ) -> jnp.ndarray:
+    # Host prep always hands int32 limb rows; the field module's lane
+    # dtype may be float32 (NARWHAL_FIELD_DTYPE) — cast once at entry.
+    a_y = a_y.astype(F.DTYPE)
+    r_y = r_y.astype(F.DTYPE)
     a_point, a_valid = decompress(a_y, a_sign, a_canon)
     r_point, r_valid = decompress(r_y, r_sign, r_canon)
     small = is_small_order(a_point) | is_small_order(r_point)

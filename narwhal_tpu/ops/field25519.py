@@ -1,27 +1,44 @@
-"""GF(2^255 - 19) arithmetic from 32-bit integer lanes, batch-first.
+"""GF(2^255 - 19) arithmetic from 32-bit vector lanes, batch-first.
 
 TPU has no native 64-bit multiply, so field elements are 32 limbs of 8
-bits (radix 2^8) held in int32.  The radix keeps every intermediate
-exactly representable in 32-bit lanes: weak limbs < 2^9, pairwise
-products < 2^18, a 32-term convolution row < 2^23.  The schoolbook
-convolution runs as 32 fused shifted multiply-accumulates on the VPU
-(see mul() for why this beats the MXU matmul formulation on v5e);
-carries, folds and comparisons are elementwise int32, also VPU.  This is
-the TPU-shaped answer to the reference's ed25519-dalek
-(crypto/src/lib.rs:206-219), whose Rust backend uses 51-bit limbs in
-u128 — a layout that cannot map to vector lanes.
+bits (radix 2^8) held in 32-bit lanes.  The radix keeps every
+intermediate exactly representable: weak limbs < 2^9, pairwise products
+< 2^18, a 32-term convolution row < 2^23.  The schoolbook convolution
+runs as 32 fused shifted multiply-accumulates on the VPU (see mul() for
+why this beats the MXU matmul formulation on v5e); carries, folds and
+comparisons are elementwise, also VPU.  This is the TPU-shaped answer to
+the reference's ed25519-dalek (crypto/src/lib.rs:206-219), whose Rust
+backend uses 51-bit limbs in u128 — a layout that cannot map to vector
+lanes.
 
-All functions are batch-first: an element is ``int32[..., 32]`` and every
-op vmaps/broadcasts over leading axes.  Limb i holds bits [8i, 8i+8).
-Outputs of mul/add/sub are *weakly reduced* (limbs < 2^9 — see carry();
-value possibly ≥ p); ``canon`` fully reduces into [0, p) with limbs < 2^8.
+Lane dtype is selected by ``NARWHAL_FIELD_DTYPE`` at import: ``int32``
+(default) or ``float32``.  The f32 variant exists because the VPU is an
+f32 machine first — if 32-bit integer multiply is emulated or
+rate-limited, the same algorithm in floats wins.  Every f32 intermediate
+is an INTEGER kept strictly below 2^24 (the f32 exact-integer range):
+the 2^23 convolution-row bound fits as-is; carries use an exact
+power-of-two scale + floor instead of shifts; mul's ×38 fold is split
+into two sub-2^24 halves (see mul()).  The same differential suite
+proves either dtype against Python big ints: the default test run
+covers int32 plus an f32 field-op subprocess check
+(tests/test_ed25519.py::test_float32_lane_mode_field_ops); the FULL
+suite under f32 is `make test-f32` — run it after touching any op here.
+
+All functions are batch-first: an element is ``[..., 32]`` of DTYPE and
+every op vmaps/broadcasts over leading axes.  Limb i holds bits
+[8i, 8i+8).  Outputs of mul/add/sub are *weakly reduced* (limbs < 2^9 —
+see carry(); value possibly ≥ p); ``canon`` fully reduces into [0, p)
+with limbs < 2^8.
 
 Correctness strategy: every op is differential-tested against Python big
-ints over random + boundary values (tests/test_field25519.py), and every
-int32 intermediate has a proven magnitude bound (see mul()).
+ints over random + boundary values, and every intermediate has a proven
+magnitude bound (2^31 budget in int32 mode, 2^24 in float32 mode — the
+tighter f32 bounds are noted where they differ).
 """
 
 from __future__ import annotations
+
+import os
 
 import numpy as np
 
@@ -36,11 +53,24 @@ P = (1 << 255) - 19
 # 2^(BITS·LIMBS) = 2^256 ≡ 38 (mod p): folding multiplier for limbs ≥ LIMBS.
 FOLD = 38
 
+_DTYPE_ENV = os.environ.get("NARWHAL_FIELD_DTYPE", "int32")
+if _DTYPE_ENV not in ("int32", "float32"):
+    # Fail loud: a typo ("f32", "fp32") silently falling back to int32
+    # would mislabel every measurement made under it.
+    raise ValueError(
+        f"NARWHAL_FIELD_DTYPE must be 'int32' or 'float32', got "
+        f"{_DTYPE_ENV!r}"
+    )
+FP = _DTYPE_ENV == "float32"
+DTYPE = jnp.float32 if FP else jnp.int32
+NP_DTYPE = np.float32 if FP else np.int32
+_INV_RADIX = 1.0 / (1 << BITS)  # exact power-of-two scale for f32 carries
+
 
 def to_limbs(x: int) -> np.ndarray:
     """Python int → limb vector (host-side prep)."""
     return np.array([(x >> (BITS * i)) & MASK for i in range(LIMBS)],
-                    dtype=np.int32)
+                    dtype=NP_DTYPE)
 
 
 def from_limbs(limbs) -> int:
@@ -49,11 +79,24 @@ def from_limbs(limbs) -> int:
     return sum(int(v) << (BITS * i) for i, v in enumerate(arr))
 
 
+def _split(c: jnp.ndarray):
+    """(carry, low 8 bits) of every limb.  int32: shift/mask.  float32:
+    exact scale-by-2^-8 + floor, then subtract back — every step is exact
+    for integer-valued c < 2^24 (scaling by a power of two never rounds,
+    floor of an exact value is exact, and hi·256 < 2^24)."""
+    if FP:
+        hi = jnp.floor(c * _INV_RADIX)
+        lo = c - hi * (1 << BITS)
+    else:
+        hi = c >> BITS
+        lo = c & MASK
+    return hi, lo
+
+
 def _carry_once(c: jnp.ndarray) -> jnp.ndarray:
     """One vectorized carry sweep; the carry out of the top limb wraps to
     limb 0 multiplied by 38 (2^256 ≡ 38 mod p)."""
-    hi = c >> BITS
-    lo = c & MASK
+    hi, lo = _split(c)
     out = lo.at[..., 1:].add(hi[..., :-1])
     return out.at[..., 0].add(hi[..., -1] * FOLD)
 
@@ -63,9 +106,10 @@ def carry(c: jnp.ndarray, sweeps: int = 4) -> jnp.ndarray:
     (NOT < 2^8 — the final sweep can both leave a limb at 255 + carry-in
     and add the ×38 top-limb wrap to limb 0, so limb 0 reaches up to
     255 + 38 = 293).  With the default 4 sweeps, input limbs may be up to
-    2^31: the sweep bounds are ≤ 255 + 2^23, ≤ 255 + 2^15, ≤ 255 + 2^7,
-    then < 2^9.  Every consumer is dimensioned for the 2^9 weak bound
-    (see mul's exactness note and sub's ZP offset).
+    2^31 (int32 mode; < 2^24 in float32 mode — every in-tree caller stays
+    under 2^23.3): the sweep bounds are ≤ 255 + 2^23, ≤ 255 + 2^15,
+    ≤ 255 + 2^7, then < 2^9.  Every consumer is dimensioned for the 2^9
+    weak bound (see mul's exactness note and sub's ZP offset).
 
     ``sweeps`` lets callers with tighter input bounds skip work (each
     sweep is ~5 vector ops on the hot path); every reduced-sweep call
@@ -79,10 +123,11 @@ def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """Field multiply, weakly reduced output.
 
     The schoolbook convolution c[k] = Σ_{i+j=k} a_i·b_j is computed as 32
-    fused shifted multiply-accumulates on the VPU, entirely in int32.
+    fused shifted multiply-accumulates on the VPU in DTYPE lanes.
     Exactness: weak limbs are < 2^9 (carry()'s bound), so pairwise
     products are < 2^18 and a convolution row accumulates ≤ 32 of them →
-    < 2^23, far inside int32.
+    < 2^23 — inside int32's 2^31 budget and f32's 2^24 exact-integer
+    range alike.
 
     Why not the MXU?  The "one-hot convolution tensor" formulation — a
     single [B·32², 63] f32 matmul — was measured 1.4× SLOWER end-to-end
@@ -91,17 +136,28 @@ def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     is 1/63, while the shifted-MAC chain fuses into one VPU kernel whose
     only HBM traffic is the operands and the result."""
     shape = jnp.broadcast_shapes(a.shape[:-1], b.shape[:-1])
-    conv = jnp.zeros(shape + (2 * LIMBS - 1,), jnp.int32)
+    conv = jnp.zeros(shape + (2 * LIMBS - 1,), DTYPE)
     pad_base = [(0, 0)] * (b.ndim - 1)
     for i in range(LIMBS):
         conv = conv + a[..., i : i + 1] * jnp.pad(
             b, pad_base + [(i, LIMBS - 1 - i)]
         )
-    # Fold limbs ≥ 32: 2^(8(32+j)) ≡ 38·2^(8j) (mod p); conv < 2^23 so the
-    # ×38 (< 2^29) stays inside int32.
+    # Fold limbs ≥ 32: 2^(8(32+j)) ≡ 38·2^(8j) (mod p).
     hi = conv[..., LIMBS:]
     lo = conv[..., :LIMBS]
-    folded = lo.at[..., : LIMBS - 1].add(hi * FOLD)
+    if FP:
+        # Direct ×38 would reach 38·2^23 ≈ 2^28.3 — outside f32's exact
+        # range.  Split each hi limb into 8-bit halves first: hi_hi < 2^15
+        # lands one limb higher (2^8·38·2^(8j) = 38·2^(8(j+1))), so both
+        # products stay < 2^21 and every folded limb < 2^23 + 2^13.3 +
+        # 2^20.3 < 2^23.3 — exact.  hi has 31 entries (j ≤ 30), so j+1 ≤
+        # 31 never needs a secondary fold.
+        hi_hi, hi_lo = _split(hi)
+        folded = lo.at[..., : LIMBS - 1].add(hi_lo * FOLD)
+        folded = folded.at[..., 1:LIMBS].add(hi_hi * FOLD)
+    else:
+        # conv < 2^23 so the ×38 (< 2^29) stays inside int32.
+        folded = lo.at[..., : LIMBS - 1].add(hi * FOLD)
     return carry(folded)
 
 
@@ -139,7 +195,7 @@ _comp = (-_base) % P
 _zp = [2 * MASK + ((_comp >> (BITS * i)) & MASK) for i in range(LIMBS)]
 assert sum(v << (BITS * i) for i, v in enumerate(_zp)) % P == 0
 assert all((1 << 9) <= v < (1 << 15) for v in _zp), _zp
-_ZP = jnp.asarray(np.array(_zp, dtype=np.int32))
+_ZP = jnp.asarray(np.array(_zp, dtype=NP_DTYPE))
 
 
 def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
@@ -159,8 +215,21 @@ def neg(a: jnp.ndarray) -> jnp.ndarray:
 
 
 def mul_small(a: jnp.ndarray, k: int) -> jnp.ndarray:
-    """Multiply by a small non-negative constant (k ≤ 2^17)."""
-    return carry(a * jnp.int32(k))
+    """Multiply by a small non-negative constant (k ≤ 2^17).
+
+    float32 mode splits k > 2^14 into 8-bit chunks (k·2^9 would pass the
+    2^24 exact range): a·k_lo < 2^17 and a·k_hi < 2^18 land one limb
+    apart, the top chunk folds ×38 into limb 0 (38·2^18 < 2^23.3), and
+    every partial stays exact."""
+    assert 0 <= k <= (1 << 17), k
+    if FP and k > (1 << 14):
+        k_hi, k_lo = k >> BITS, k & MASK
+        lo_part = a * jnp.asarray(k_lo, DTYPE)
+        hi_part = a * jnp.asarray(k_hi, DTYPE)
+        c = lo_part.at[..., 1:].add(hi_part[..., :-1])
+        c = c.at[..., 0].add(hi_part[..., -1] * FOLD)
+        return carry(c)
+    return carry(a * jnp.asarray(k, DTYPE))
 
 
 def pow2k(a: jnp.ndarray, k: int) -> jnp.ndarray:
@@ -214,10 +283,15 @@ def _sub_p(c: jnp.ndarray):
     def step(borrow, d_i):
         v = d_i - borrow
         neg_ = v < 0
-        v = v + jnp.where(neg_, jnp.int32(1 << BITS), jnp.int32(0))
-        return jnp.where(neg_, jnp.int32(1), jnp.int32(0)), v
+        v = v + jnp.where(
+            neg_, jnp.asarray(1 << BITS, DTYPE), jnp.asarray(0, DTYPE)
+        )
+        return (
+            jnp.where(neg_, jnp.asarray(1, DTYPE), jnp.asarray(0, DTYPE)),
+            v,
+        )
 
-    borrow0 = jnp.zeros(c.shape[:-1], dtype=jnp.int32)
+    borrow0 = jnp.zeros(c.shape[:-1], dtype=DTYPE)
     borrow, limbs = jax.lax.scan(step, borrow0, d_first)
     return jnp.moveaxis(limbs, 0, -1), borrow > 0
 

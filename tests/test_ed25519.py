@@ -268,3 +268,56 @@ def test_tpu_averify_runs_off_event_loop():
     assert mask == [True, False, True]
     assert threads and threads[0].startswith("tpu-verify"), threads
     assert ticks, "event loop starved during device verify"
+
+
+def test_float32_lane_mode_field_ops():
+    """The float32 lane dtype (NARWHAL_FIELD_DTYPE=float32) computes the
+    dtype-sensitive pieces — field mul/sub/canon (split carries, split
+    ×38 fold, ×k chunking) and the one-hot table select — exactly, in a
+    subprocess so the env-selected dtype is picked up at import.  Scoped
+    to ops that compile in seconds; the FULL verify kernel under f32
+    (several minutes of cold CPU compile) is covered by running
+    `NARWHAL_FIELD_DTYPE=float32 pytest tests/test_field25519.py
+    tests/test_ed25519.py`."""
+    import os
+    import subprocess
+    import sys
+
+    code = """
+import sys
+sys.path.insert(0, %r)
+# Pin the CPU backend the same way conftest does: a host sitecustomize
+# may re-register an accelerator platform over JAX_PLATFORMS, and an
+# unhealthy device tunnel would hang the first computation.
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from narwhal_tpu.ops import field25519 as F
+assert F.FP and F.DTYPE.__name__ == "float32"
+rng = np.random.default_rng(3)
+P = F.P
+for _ in range(8):
+    x = int(rng.integers(0, 1 << 62)) * int(rng.integers(0, 1 << 62)) %% P
+    y = (P - 1 - x) %% P
+    xl, yl = F.to_limbs(x)[None], F.to_limbs(y)[None]
+    assert F.from_limbs(np.asarray(F.mul(xl, yl))[0]) %% P == x * y %% P
+    assert F.from_limbs(np.asarray(F.sub(xl, yl))[0]) %% P == (x - y) %% P
+    assert F.from_limbs(np.asarray(F.mul_small(xl, 121666))[0]) %% P == (
+        x * 121666 %% P)
+    assert F.from_limbs(np.asarray(F.canon(xl))[0]) == x
+from narwhal_tpu.ops import ed25519 as E
+import jax.numpy as jnp
+pt = E._select_from_table(E._B_TABLE, jnp.asarray([3, 0, 15]))
+got = [F.from_limbs(np.asarray(c)[0]) for c in pt]
+exp_x, exp_y = E._ref_scalarmult(3)
+assert got[0] == exp_x and got[1] == exp_y and got[2] == 1
+print("F32-OK")
+""" % os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, NARWHAL_FIELD_DTYPE="float32")
+    out = subprocess.run(
+        [sys.executable, "-c", code], env=env, capture_output=True,
+        text=True, timeout=300,
+    )
+    assert out.returncode == 0 and "F32-OK" in out.stdout, (
+        out.stdout, out.stderr
+    )

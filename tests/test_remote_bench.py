@@ -47,7 +47,17 @@ def test_non_collocated_placement_commits(tmp_path):
         base_port=7960,
         quiet=True,
         collocate=False,
+        keep_logs=True,
     )
     assert result.errors == []
     assert result.committed_batches > 0
     assert result.samples > 0
+    # Structural check of the property in this test's name: with stride
+    # 1+workers = 2 over 2 hosts, every primary lands on h0 and every
+    # worker on h1 — a placement regression that silently re-collocated
+    # them would commit just fine, so assert where the logs ended up.
+    for i in range(4):
+        assert os.path.exists(f"{tmp_path}/h0/logs/primary-{i}.log")
+        assert not os.path.exists(f"{tmp_path}/h1/logs/primary-{i}.log")
+        assert os.path.exists(f"{tmp_path}/h1/logs/worker-{i}-0.log")
+        assert not os.path.exists(f"{tmp_path}/h0/logs/worker-{i}-0.log")
