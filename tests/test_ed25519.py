@@ -307,10 +307,12 @@ for _ in range(8):
     assert F.from_limbs(np.asarray(F.canon(xl))[0]) == x
 from narwhal_tpu.ops import ed25519 as E
 import jax.numpy as jnp
-pt = E._select_from_table(E._B_TABLE, jnp.asarray([3, 0, 15]))
-got = [F.from_limbs(np.asarray(c)[0]) for c in pt]
-exp_x, exp_y = E._ref_scalarmult(3)
-assert got[0] == exp_x and got[1] == exp_y and got[2] == 1
+ws = [3, 0, 15]
+pt = E._select_from_table(E._B_TABLE, jnp.asarray(ws))
+for row, w in enumerate(ws):
+    got = [F.from_limbs(np.asarray(c)[row]) for c in pt]
+    exp_x, exp_y = E._ref_scalarmult(w)
+    assert got[0] == exp_x and got[1] == exp_y and got[2] == 1, (w, got)
 print("F32-OK")
 """ % os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env = dict(os.environ, NARWHAL_FIELD_DTYPE="float32")

@@ -11,16 +11,42 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from benchmark.remote_bench import run_remote_bench  # noqa: E402
 
 
+def _run_committee(tmp_path, **kwargs):
+    """One retry on a failed window: these are fixed-duration measurement
+    runs (boot → commit for N seconds → parse), and on a shared single
+    core a background CPU spike during the window can starve the whole
+    committee past its deadlines — a host artifact, not a protocol bug
+    (the protocol-level e2e tests in test_e2e.py poll with generous
+    deadlines instead and don't need this).  A genuine regression fails
+    both attempts."""
+    hosts = [f"{tmp_path}/h0", f"{tmp_path}/h1"]
+    for attempt in (1, 2):
+        result = run_remote_bench(
+            [f"local:{h}" for h in hosts], quiet=True, **kwargs
+        )
+        ok = (
+            result.errors == []
+            and result.committed_batches > 0
+            and result.samples > 0
+        )
+        if ok or attempt == 2:
+            return result
+        print(
+            f"window {attempt} failed (errors={result.errors!r}, "
+            f"committed={result.committed_batches}); retrying",
+            file=sys.stderr,
+        )
+
+
 def test_two_host_committee_commits(tmp_path):
-    result = run_remote_bench(
-        [f"local:{tmp_path}/h0", f"local:{tmp_path}/h1"],
+    result = _run_committee(
+        tmp_path,
         nodes=4,
         workers=1,
         rate=2_000,
         tx_size=512,
         duration=8,
         base_port=7910,
-        quiet=True,
     )
     assert result.errors == []
     assert result.committed_batches > 0
@@ -33,9 +59,8 @@ def test_non_collocated_placement_commits(tmp_path):
     different "hosts" (reference remote.py:108-130); the primary↔worker
     hop crosses host boundaries and the committee still commits client
     payloads end-to-end."""
-    hosts = [f"local:{tmp_path}/h{j}" for j in range(2)]
-    result = run_remote_bench(
-        hosts,
+    result = _run_committee(
+        tmp_path,
         nodes=4,
         workers=1,
         rate=2_000,
@@ -45,7 +70,6 @@ def test_non_collocated_placement_commits(tmp_path):
         # hop, and on a shared-core CI host an 8 s window has flaked.
         duration=12,
         base_port=7960,
-        quiet=True,
         collocate=False,
         keep_logs=True,
     )
