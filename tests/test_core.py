@@ -327,3 +327,60 @@ def test_stale_burst_item_replays_fail_closed(run):
         core.network.close()
 
     run(go())
+
+
+def test_certificate_waiter_parks_until_parents_stored(run):
+    """CertificateWaiter (reference certificate_waiter.rs): a certificate
+    whose parents are missing parks on notify_read and loops back to the
+    Core only once EVERY parent digest hits the store; GC cancels parked
+    waits that fall behind the consensus round."""
+    from narwhal_tpu.primary.certificate_waiter import CertificateWaiter
+
+    async def go():
+        c = committee(base_port=13400)
+        kps = keys()
+        store = Store()
+        consensus_round = AtomicRound()
+        rx, tx_core = asyncio.Queue(), asyncio.Queue()
+        waiter = CertificateWaiter(
+            store, consensus_round, gc_depth=50, rx_synchronizer=rx,
+            tx_core=tx_core,
+        )
+        task = asyncio.get_running_loop().create_task(waiter.run())
+
+        parents = {h.digest() for h in genesis(c)}
+        header = make_header(kps[0], round_=1, parents=parents, c=c)
+        cert = make_certificate(header)
+        await rx.put(cert)
+        await asyncio.sleep(0.05)
+        assert tx_core.empty()  # parked: no parent is stored yet
+
+        some = list(parents)
+        store.write(bytes(some[0]), b"\x01")
+        await asyncio.sleep(0.05)
+        assert tx_core.empty()  # one of several parents isn't enough
+
+        for d in some[1:]:
+            store.write(bytes(d), b"\x01")
+        released = await asyncio.wait_for(tx_core.get(), 5)
+        assert released.digest() == cert.digest()
+        assert cert.digest() not in waiter.pending
+
+        # GC: park a second certificate, advance the consensus round past
+        # the GC window, and poke the waiter — the parked task is dropped.
+        header2 = make_header(kps[1], round_=1, parents=parents, c=c)
+        cert2 = make_certificate(header2)
+        # Remove one parent so it stays parked (fresh store key space).
+        store2 = Store()
+        waiter.store = store2
+        await rx.put(cert2)
+        await asyncio.sleep(0.05)
+        assert cert2.digest() in waiter.pending
+        consensus_round.value = 100  # gc_round = 50 >= cert2.round
+        header3 = make_header(kps[2], round_=1, parents=parents, c=c)
+        await rx.put(make_certificate(header3))  # any arrival triggers _gc
+        await asyncio.sleep(0.05)
+        assert cert2.digest() not in waiter.pending
+        task.cancel()
+
+    run(go())
