@@ -128,8 +128,11 @@ def main() -> None:
     msgs, keys, sigs = make_batch(max(args.batches))
 
     cpu_vps = bench_cpu(msgs, keys, sigs, args.cpu_budget)
+    from narwhal_tpu.ops import field25519 as F
+
     results = {
         "metric": "ed25519_verifies_per_sec_chip",
+        "lane_dtype": "float32" if F.FP else "int32",
         "cpu_openssl_verifies_per_s_core": round(cpu_vps, 1),
         "host_cores": os.cpu_count(),
         "tpu": [],
@@ -154,6 +157,7 @@ def main() -> None:
                 "metric": "ed25519_verifies_per_sec_chip",
                 "value": results["best_verifies_per_s_chip"],
                 "unit": "verifies/s",
+                "lane_dtype": results["lane_dtype"],
                 "vs_baseline": results["vs_cpu_core"],
                 "cpu_core_verifies_per_s": results[
                     "cpu_openssl_verifies_per_s_core"

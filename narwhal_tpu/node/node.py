@@ -106,6 +106,15 @@ async def spawn_primary_node(
         tx_output=tx_output,
         benchmark=benchmark,
         use_kernel=use_kernel,
+        # Committed-frontier crash recovery (beyond reference parity):
+        # a small atomically-rewritten file next to the store log, so a
+        # restarted primary's ordering anchors at its old frontier and
+        # replayed history can't re-enter the commit sequence (rationale
+        # in Consensus.__init__).  Memory-only nodes (store_path=None,
+        # tests/benches) skip it.
+        checkpoint_path=(
+            store_path + ".consensus.ckpt" if store_path else None
+        ),
     )
     if hasattr(consensus.tusk, "prewarm"):
         log.info("Warming up consensus kernel...")
