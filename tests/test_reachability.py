@@ -238,3 +238,33 @@ def test_stall_beyond_window_falls_back_to_python():
     # The kernel path did run after the stall, always at the static shape.
     assert calls, "kernel never used after catch-up"
     assert all(w == kernel_tusk.max_window for w in calls), calls
+
+
+def test_kernel_restore_resumes_like_golden():
+    """Checkpoint restore under the device kernel: a KernelTusk restored
+    from a golden instance's frontier (Consensus realigns the dense
+    window via _win_shift, consensus/tusk.py) must skip a full catch-up
+    replay of committed history and then commit new rounds identically
+    to the uninterrupted golden instance."""
+    c = committee()
+    names = sorted_names()
+    certs, next_parents = make_certificates(1, 4, genesis_digests(c), names)
+    _, trigger = mock_certificate(names[0], 5, next_parents)
+
+    golden = Tusk(c, gc_depth=50, fixed_coin=True)
+    assert feed(golden, certs + [trigger])
+    blob = golden.state.snapshot_bytes()
+
+    kernel = KernelTusk(c, gc_depth=50, fixed_coin=True)
+    kernel.state.restore(blob)
+    kernel._win_shift()  # what Consensus.__init__ does after a restore
+    assert kernel._win_base == golden.state.last_committed_round
+    assert feed(kernel, certs + [trigger]) == []
+
+    more, tail_parents = make_certificates(5, 8, next_parents, names)
+    more = more[1:]  # round-5 leader already exists as `trigger`
+    _, trigger2 = mock_certificate(names[0], 9, tail_parents)
+    got = feed(kernel, more + [trigger2])
+    want = feed(golden, more + [trigger2])
+    assert [x.digest() for x in got] == [x.digest() for x in want]
+    assert got
