@@ -6,17 +6,22 @@ worker each plus open-loop clients on localhost (benchmark/local_bench.py) —
 and reports end-to-end committed TPS against the reference's local baseline
 (46,149 tx/s e2e, README.md:42-58, mirrored in BASELINE.md).
 
-Environment knobs: BENCH_DURATION (s, default 25), BENCH_RATE (tx/s, default
-90000), BENCH_NODES (default 4), BENCH_BATCH (bytes, default 500000).
+Environment knobs: BENCH_DURATION (s, default 25), BENCH_RATE (starting
+probe rate, default 90000), BENCH_NODES (default 4), BENCH_BATCH (bytes,
+default 500000), BENCH_LATENCY_CAP_MS (sustained-point gate, default 1500),
+BENCH_MAX_PROBES (default 4).
 
-The input rate sits at the measured saturation point (like the reference's
-own benchmark methodology: drive load to saturation, report the sustained
-committed TPS).  The round-5 sweep on the 1-core driver host
-(artifacts/sweep_4n_r05.json) peaks around rate 90k: committed e2e TPS
-climbs to ~60-62k there and degrades with rising latency beyond ~100k.
-Batch size stays at the reference's 500 kB — the earlier 125 kB "tuned"
-default quartered throughput by quadrupling per-batch overheads (broadcast
-frames, ACK round trips, digests, store records).
+Saturation is PROBED PER RUN, not replayed from a previous round's
+measurement: this host's capacity swings ±30% between hours (BASELINE.md
+variance caveat), and offering a fixed rate measured in a fast window
+floods the queues of a slow one — round 5 measured 32.6k tx/s at 3,037 ms
+e2e latency exactly that way (VERDICT.md §1).  The probe steps the offered
+rate DOWN from BENCH_RATE (factor 0.7) until a run commits with e2e latency
+under the cap — i.e. the committee is saturated but not drowning — then
+re-runs the chosen rate for the median.  Every probe run is listed in the
+JSON.  Batch size stays at the reference's 500 kB — the earlier 125 kB
+"tuned" default quartered throughput by quadrupling per-batch overheads
+(broadcast frames, ACK round trips, digests, store records).
 """
 
 import json
@@ -34,30 +39,55 @@ def main() -> None:
     from benchmark.local_bench import run_bench
 
     duration = int(os.environ.get("BENCH_DURATION", "25"))
-    rate = int(os.environ.get("BENCH_RATE", "90000"))
+    start_rate = int(os.environ.get("BENCH_RATE", "90000"))
     nodes = int(os.environ.get("BENCH_NODES", "4"))
     batch = int(os.environ.get("BENCH_BATCH", "500000"))
     runs = int(os.environ.get("BENCH_RUNS", "3"))
+    lat_cap = float(os.environ.get("BENCH_LATENCY_CAP_MS", "1500"))
+    max_probes = int(os.environ.get("BENCH_MAX_PROBES", "4"))
 
-    # A saturation benchmark on a shared-core host is noisy (scheduling
-    # jitter decides when congestion onset hits); run several times and
-    # report the MEDIAN run (robust against one lucky or one degraded run;
-    # unlike max-of-N it does not inflate with more runs), listing every
-    # run in the JSON.
-    results = []
-    for _ in range(max(1, runs)):
-        results.append(
-            run_bench(
-                nodes=nodes,
-                workers=1,
-                rate=rate,
-                tx_size=512,
-                duration=duration,
-                base_port=7100,
-                batch_size=batch,
-                quiet=True,
-            )
+    def one_run(rate):
+        return run_bench(
+            nodes=nodes,
+            workers=1,
+            rate=rate,
+            tx_size=512,
+            duration=duration,
+            base_port=7100,
+            batch_size=batch,
+            quiet=True,
         )
+
+    def sustained(r):
+        # Saturated-but-not-drowning: commits flow and the e2e latency is
+        # bounded (an open-loop client over capacity inflates latency
+        # without bound — the round-5 3 s failure mode).
+        return r.end_to_end_tps > 0 and r.end_to_end_latency_ms <= lat_cap
+
+    # Step the offered rate down from the optimistic start until one run
+    # sustains; a slow host window then reports its true sustained point
+    # instead of a queue-flooded one.
+    probes = []  # (rate, result)
+    rate = start_rate
+    for _ in range(max(1, max_probes)):
+        r = one_run(rate)
+        probes.append((rate, r))
+        if sustained(r):
+            break
+        rate = max(int(rate * 0.7), 5_000)
+
+    # Chosen rate: the first sustained probe, else the best-TPS probe
+    # (reported as-is — the artifact shows its over-cap latency).
+    chosen_rate = next(
+        (rt for rt, r in probes if sustained(r)),
+        max(probes, key=lambda p: p[1].end_to_end_tps)[0],
+    )
+    # Re-run the chosen rate up to BENCH_RUNS total and report the MEDIAN
+    # run (robust against one lucky or one degraded run; unlike max-of-N
+    # it does not inflate with more runs), listing every run in the JSON.
+    results = [r for rt, r in probes if rt == chosen_rate]
+    while len(results) < max(1, runs):
+        results.append(one_run(chosen_rate))
     ranked = sorted(results, key=lambda r: r.end_to_end_tps)
     result = ranked[len(ranked) // 2]
 
@@ -153,6 +183,16 @@ def main() -> None:
                 "value": round(tps, 1),
                 "unit": "tx/s",
                 "vs_baseline": round(tps / baseline, 4),
+                "offered_rate": chosen_rate,
+                "probe_history": [
+                    {
+                        "rate": rt,
+                        "e2e_tps": round(r.end_to_end_tps, 1),
+                        "e2e_latency_ms": round(r.end_to_end_latency_ms, 1),
+                        "sustained": sustained(r),
+                    }
+                    for rt, r in probes
+                ],
                 "runs_e2e_tps": [round(r.end_to_end_tps, 1) for r in results],
                 "consensus_latency_ms": round(result.consensus_latency_ms, 1),
                 "end_to_end_latency_ms": round(result.end_to_end_latency_ms, 1),

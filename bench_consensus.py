@@ -1,19 +1,37 @@
 #!/usr/bin/env python3
-"""KernelTusk microbenchmark: device leader-chain scan vs golden Python walk.
+"""KernelTusk microbenchmark: device-resident commit path vs golden Python.
 
 The reference's commit rule does one `linked()` BFS per earlier leader per
 commit attempt (consensus/src/lib.rs:224-259); KernelTusk collapses the
-whole chain into one jitted scan (narwhal_tpu/ops/reachability.py).  This
-measures `order_leaders` wall time for both implementations over identical
-DAG state at committee sizes N ∈ {4, 20, 50} and a gc_depth-50 window —
-the "large-DAG scaling" duty from SURVEY.md §5.
+whole chain into one jitted scan over a device-resident dense window
+(narwhal_tpu/ops/reachability.py).  This measures BOTH protocol phases for
+both implementations over identical DAG state at committee sizes
+N ∈ {4, 20, 50} and a gc_depth-50 window:
 
-Methodology: build `span` rounds of a full DAG (every authority, full
-parent links — the densest, worst case), call order_leaders on the newest
-anchor leader T times, report the median per-call time.  The kernel path
-is prewarmed first (one static shape; persistent compile cache applies).
+- **insert** — the certificate-arrival path.  Python: one dict insert.
+  Kernel: one dict insert + an O(1) staging append (all window resolution
+  is deferred to the commit opportunity).  Reported as the min wall time
+  of inserting `span` PRE-CREATED full rounds over `--build-reps`
+  interleaved passes — certificate construction/hashing is excluded (it
+  is identical for both arms and an order of magnitude heavier than the
+  arrival path, so timing it in-loop drowned the comparison in jitter).
+- **commit** — one commit opportunity.  Python: `order_leaders` (the
+  linked-BFS chain walk).  Kernel: flush the staged arrivals since the
+  last opportunity (two rounds' worth — one `window_apply` scatter
+  dispatch at steady state) + one `leader_commit_scan` dispatch + the
+  W-bool committed-bitmap fetch.  The per-iteration re-staging makes the
+  kernel number an honest STEADY-STATE cost, not an empty-pending fast
+  path.
 
-    python bench_consensus.py --sizes 4 20 50 --span 48 --iters 5 \
+Floor honesty: every kernel commit pays one device round trip for the
+bitmap fetch.  On a tunneled/remote chip that fetch floor (~69 ms
+measured in round 5) dominates; on a host-local device it is ~0.1 ms.
+The artifact reports the measured floor, the raw speedup, and the
+floor-subtracted speedup (the host-local-chip estimate) side by side —
+the acceptance gate (ISSUE r06) is floor-subtracted commit speedup > 1
+at N ≥ 20 AND kernel insert ≤ Python insert.
+
+    python bench_consensus.py --sizes 4 20 50 --span 48 --iters 9 \
         --artifact artifacts/consensus_bench.json
 """
 
@@ -59,97 +77,215 @@ def mock_certificate(origin, round_, parents) -> Certificate:
     return Certificate(header=header, votes=[])
 
 
-def build_state(tusk: Tusk, committee: Committee, span: int):
-    """Fill the DAG with `span` full rounds WITHOUT committing (inserted
-    via insert_certificate so KernelTusk maintains its dense window, but
-    the commit rule is bypassed), then return the anchor leader
-    certificate for order_leaders.  Returns (anchor, insert_seconds)."""
+def make_dag_certs(committee: Committee, span: int):
+    """Pre-create `span` full rounds of certificates (the densest, worst
+    case) OUTSIDE any timed region: certificate construction and header
+    hashing are identical for both implementations and an order of
+    magnitude heavier than the arrival path itself — timing them alongside
+    the inserts drowned the comparison in shared-core jitter.  Returns
+    (certs_in_arrival_order, tail_certs) where tail_certs are the last two
+    rounds — the re-staging unit for steady-state commit measurement."""
     names = sorted(committee.authorities.keys())
     parents = {c.digest() for c in genesis(committee)}
-    t0 = time.perf_counter()
+    certs, rounds = [], []
     for r in range(1, span + 1):
         nxt = set()
+        this_round = []
         for name in names:
             cert = mock_certificate(name, r, parents)
-            tusk.insert_certificate(cert)
+            certs.append(cert)
+            this_round.append(cert)
             nxt.add(cert.digest())
+        rounds.append(this_round)
         parents = nxt
-    insert_s = time.perf_counter() - t0
-    # Anchor: leader of the last even round.
+    tail = [c for rnd in rounds[-2:] for c in rnd]
+    return certs, tail
+
+
+def build_state(tusk: Tusk, certs) -> float:
+    """Feed pre-created certificates through insert_certificate (the
+    arrival path, commit rule bypassed); returns the wall seconds of the
+    insert loop alone."""
+    t0 = time.perf_counter()
+    for cert in certs:
+        tusk.insert_certificate(cert)
+    return time.perf_counter() - t0
+
+
+def find_anchor(tusk: Tusk, committee: Committee, span: int):
     anchor_round = span if span % 2 == 0 else span - 1
-    leader_name = tusk._sorted_keys[0 if tusk.fixed_coin else anchor_round % len(names)]
-    anchor = tusk.state.dag[anchor_round][leader_name][1]
-    return anchor, insert_s
+    n = len(committee.authorities)
+    leader_name = tusk._sorted_keys[
+        0 if tusk.fixed_coin else anchor_round % n
+    ]
+    return tusk.state.dag[anchor_round][leader_name][1]
 
 
-def bench_one(cls, committee, span, iters, prewarm=False):
-    tusk = cls(committee, gc_depth=50, fixed_coin=True)
-    if prewarm and hasattr(tusk, "prewarm"):
-        tusk.prewarm()
-    anchor, insert_s = build_state(tusk, committee, span)
-    times = []
-    chain_len = None
+def bench_pair(kernel_cls, committee, span, iters, build_reps):
+    """Measure BOTH implementations with interleaved timed regions: on a
+    shared-core host, back-to-back phases land in different scheduling
+    windows and a ±5× jitter swamps the comparison (observed while
+    building this bench); alternating python/kernel inside each rep makes
+    both arms share the same noise."""
+    # Absorb jit compiles / cache loads outside every timed region.
+    kernel_cls(committee, gc_depth=50, fixed_coin=True).prewarm()
+    certs, tail = make_dag_certs(committee, span)
+
+    py_ins, ke_ins = [], []
+    py = ke = None
+    for rep in range(max(1, build_reps)):
+        builds = [
+            (Tusk, py_ins),
+            (kernel_cls, ke_ins),
+        ]
+        if rep % 2:  # alternate order to cancel slow-window drift
+            builds.reverse()
+        for cls, sink in builds:
+            tusk = cls(committee, gc_depth=50, fixed_coin=True)
+            sink.append(build_state(tusk, certs))
+            if cls is Tusk:
+                py = tusk
+            else:
+                ke = tusk
+    py_anchor = find_anchor(py, committee, span)
+    ke_anchor = find_anchor(ke, committee, span)
+
+    # First kernel call: flushes the ENTIRE span in chunked scatter
+    # dispatches (the catch-up worst case); reported separately.
+    t0 = time.perf_counter()
+    ke_chain = ke.order_leaders(ke_anchor)
+    first_call_s = time.perf_counter() - t0
+
+    py_commit, ke_commit = [], []
+    py_chain = None
     for _ in range(iters):
         t0 = time.perf_counter()
-        chain = tusk.order_leaders(anchor)
-        times.append(time.perf_counter() - t0)
-        chain_len = len(chain)
-    # Insert time is reported ALONGSIDE the order_leaders comparison (as
-    # python_insert_ms / kernel_insert_ms columns), not folded into the
-    # speedup: the kernel's incremental window maintenance happens on the
-    # certificate-arrival path, the scan on the commit path.
-    return statistics.median(times), chain_len, insert_s
+        py_chain = py.order_leaders(py_anchor)
+        py_commit.append(time.perf_counter() - t0)
+        # Steady state for the kernel: a commit opportunity arrives every
+        # two rounds, so each measured call flushes two rounds' worth of
+        # staged certificates (idempotent device scatter) before the scan.
+        ke._pending.extend(tail)
+        t0 = time.perf_counter()
+        ke_chain = ke.order_leaders(ke_anchor)
+        ke_commit.append(time.perf_counter() - t0)
+    # Insert reports min-of-reps: the arms differ by one list append per
+    # certificate, far below this host's scheduling jitter, and min is the
+    # least-noise estimator for identical CPU-bound work.  Commit reports
+    # the median of the interleaved iterations.
+    return {
+        "python": {
+            "insert_s": min(py_ins),
+            "commit_s": statistics.median(py_commit),
+            "chain": [bytes(c.digest()) for c in py_chain],
+        },
+        "kernel": {
+            "insert_s": min(ke_ins),
+            "commit_s": statistics.median(ke_commit),
+            "first_call_s": first_call_s,
+            "chain": [bytes(c.digest()) for c in ke_chain],
+        },
+    }
+
+
+def measure_fetch_floor():
+    """Fixed device round-trip floor on this host: median wall time of a
+    trivial jitted compute + result fetch.  On a tunneled/remote chip this
+    floor (not the scan) dominates kernel commit time; on a host-local
+    chip it is ~0.1 ms."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    f = jax.jit(lambda x: x + 1)
+    x = jnp.zeros(8, jnp.int32)
+    np.asarray(f(x))
+    ts = []
+    for _ in range(7):
+        t0 = time.perf_counter()
+        np.asarray(f(x))
+        ts.append(time.perf_counter() - t0)
+    return sorted(ts)[3]
 
 
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--sizes", type=int, nargs="+", default=[4, 20, 50])
     ap.add_argument("--span", type=int, default=48)
-    ap.add_argument("--iters", type=int, default=5)
+    ap.add_argument("--iters", type=int, default=9)
+    ap.add_argument("--build-reps", type=int, default=3)
     ap.add_argument("--artifact", type=str, default=None)
     args = ap.parse_args()
 
+    import jax
+
     from narwhal_tpu.ops.reachability import KernelTusk
 
-    # Fixed device round-trip floor on this host: median wall time of a
-    # trivial jitted compute + result fetch.  On a tunneled/remote chip this
-    # floor (not the scan) dominates kernel_ms; on a host-local chip it is
-    # ~0.1 ms and the scan wins at large committees.
-    import jax
-    import jax.numpy as jnp
-    import numpy as _np
-
-    _f = jax.jit(lambda x: x + 1)
-    _x = jnp.zeros(8, jnp.int32)
-    _np.asarray(_f(_x))
-    _ts = []
-    for _ in range(7):
-        _t0 = time.perf_counter()
-        _np.asarray(_f(_x))
-        _ts.append(time.perf_counter() - _t0)
-    rtt_floor_ms = round(sorted(_ts)[3] * 1e3, 2)
+    floor_s = measure_fetch_floor()
+    rtt_floor_ms = round(floor_s * 1e3, 3)
     print(json.dumps({"device_roundtrip_floor_ms": rtt_floor_ms}))
 
     results = []
     for n in args.sizes:
         committee = make_committee(n)
-        py_t, py_chain, py_ins = bench_one(Tusk, committee, args.span, args.iters)
-        k_t, k_chain, k_ins = bench_one(
-            KernelTusk, committee, args.span, args.iters, prewarm=True
+        pair = bench_pair(
+            KernelTusk, committee, args.span, args.iters, args.build_reps
         )
-        assert py_chain == k_chain, (py_chain, k_chain)
+        py, ke = pair["python"], pair["kernel"]
+        assert py["chain"] == ke["chain"], (
+            f"commit chains diverge at N={n}: "
+            f"python {len(py['chain'])} vs kernel {len(ke['chain'])}"
+        )
+        ke_commit_floorsub = max(ke["commit_s"] - floor_s, 0.0)
+        # When the separately-measured floor swallows the whole commit time
+        # the floor-subtracted estimate is degenerate (dividing by ~0 would
+        # print an absurd speedup and could spuriously pass the acceptance
+        # gate); report null and let acceptance fall back to the raw ratio.
+        fs_speedup = (
+            round(py["commit_s"] / ke_commit_floorsub, 2)
+            if ke_commit_floorsub > 0.1 * ke["commit_s"]
+            else None
+        )
         row = {
             "committee": n,
             "span_rounds": args.span,
-            "leaders_in_chain": py_chain,
-            "python_ms": round(py_t * 1e3, 2),
-            "kernel_ms": round(k_t * 1e3, 2),
-            "speedup": round(py_t / k_t, 2),
-            "python_insert_ms": round(py_ins * 1e3, 2),
-            "kernel_insert_ms": round(k_ins * 1e3, 2),
+            "leaders_in_chain": len(py["chain"]),
+            # arrival path (insert loop over span rounds, min of build-reps)
+            "python_insert_ms": round(py["insert_s"] * 1e3, 2),
+            "kernel_insert_ms": round(ke["insert_s"] * 1e3, 2),
+            # commit path (per opportunity, steady state)
+            "python_commit_ms": round(py["commit_s"] * 1e3, 3),
+            "kernel_commit_ms": round(ke["commit_s"] * 1e3, 3),
+            "kernel_commit_ms_floor_subtracted": round(
+                ke_commit_floorsub * 1e3, 3
+            ),
+            # catch-up worst case: first call flushes the whole span
+            "kernel_full_span_flush_ms": round(ke["first_call_s"] * 1e3, 2),
+            "commit_speedup_raw": round(py["commit_s"] / ke["commit_s"], 2),
+            "commit_speedup_floor_subtracted": fs_speedup,
+            "insert_overhead_pct": round(
+                (ke["insert_s"] / py["insert_s"] - 1) * 100, 1
+            ),
         }
         results.append(row)
         print(json.dumps(row))
+
+    # Gate on the floor-subtracted ratio where it's meaningful, else the
+    # raw one (fetch-bound regime: the raw number IS the honest cost).
+    def gate_speedup(r):
+        fs = r["commit_speedup_floor_subtracted"]
+        return fs if fs is not None else r["commit_speedup_raw"]
+
+    acceptance = {
+        "commit_speedup_floor_subtracted_gt1_at_n_ge_20": all(
+            gate_speedup(r) > 1 for r in results if r["committee"] >= 20
+        ),
+        "kernel_insert_not_worse_than_python": all(
+            r["kernel_insert_ms"] <= r["python_insert_ms"]
+            for r in results
+        ),
+    }
+    print(json.dumps({"acceptance": acceptance}))
 
     if args.artifact:
         os.makedirs(os.path.dirname(args.artifact) or ".", exist_ok=True)
@@ -159,13 +295,18 @@ def main() -> None:
                     "device": str(jax.devices()[0]),
                     "device_roundtrip_floor_ms": rtt_floor_ms,
                     "note": (
-                        "kernel_ms includes one device round trip per "
-                        "order_leaders call; when the floor above dominates "
-                        "kernel_ms, the scan itself is round-trip-bound "
-                        "(tunneled chip), not compute-bound — subtract the "
-                        "floor for the host-local-chip estimate"
+                        "kernel_commit_ms is the steady-state cost of one "
+                        "commit opportunity: flush two staged rounds "
+                        "(donated scatter) + one chain scan + the W-bool "
+                        "committed-bitmap fetch — the only device round "
+                        "trip on the path.  The floor-subtracted column "
+                        "removes that fetch floor (dominant on a tunneled "
+                        "chip, ~0.1 ms host-local) for the host-local-chip "
+                        "estimate.  kernel_full_span_flush_ms is the "
+                        "catch-up worst case (whole span staged at once)."
                     ),
                     "rows": results,
+                    "acceptance": acceptance,
                 },
                 f,
                 indent=2,

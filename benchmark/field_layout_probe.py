@@ -123,6 +123,32 @@ def main() -> None:
     a = rng.integers(0, 256, (args.batch, LIMBS), dtype=np.int32)
     b = rng.integers(0, 256, (args.batch, LIMBS), dtype=np.int32)
 
+    # Drift guard (ADVICE.md r05): _mul_limbs_minor is a hand-maintained
+    # copy of the live field25519.mul int32 path; any future edit to the
+    # live mul would silently desynchronize the A/B arms.  Cross-check the
+    # copy against the LIVE mul on a random sub-batch before measuring, so
+    # drift fails loudly here instead of corrupting layout comparisons.
+    if os.environ.get("NARWHAL_FIELD_DTYPE", "int32") == "int32":
+        from narwhal_tpu.ops import field25519 as F
+
+        k = min(args.batch, 512)
+        live = np.asarray(F.mul(jnp.asarray(a[:k]), jnp.asarray(b[:k])))
+        copy = np.asarray(
+            jax.jit(_mul_limbs_minor)(jnp.asarray(a[:k]), jnp.asarray(b[:k]))
+        )
+        if not (live == copy).all():
+            raise SystemExit(
+                "field_layout_probe: _mul_limbs_minor has DRIFTED from the "
+                "live field25519.mul — update the inline copy before "
+                "trusting any layout measurement from this probe"
+            )
+    else:
+        print(
+            "NOTE: NARWHAL_FIELD_DTYPE != int32; live-mul drift guard "
+            "skipped (the probe's arms are the int32 layouts)",
+            file=sys.stderr,
+        )
+
     # Fetch floor: trivial jitted compute + fetch.
     f = jax.jit(lambda x: x + 1)
     x = jnp.zeros(8, jnp.int32)

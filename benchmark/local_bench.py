@@ -190,7 +190,7 @@ def run_bench(
     elif crypto_backend:
         base_flags += ["--crypto-backend", crypto_backend]
     if consensus_kernel:
-        device_flags += ["--consensus-kernel"]
+        device_flags += ["--experimental-consensus-kernel"]
 
     alive = nodes - faults  # crash faults: the last `faults` nodes never boot
     any_tpu = bool(device_flags)
@@ -214,7 +214,7 @@ def run_bench(
             f"{workdir}/committee.json",
         ]
         if consensus_kernel:
-            warm_cmd.append("--consensus-kernel")
+            warm_cmd.append("--experimental-consensus-kernel")
         if crypto_backend != "tpu":
             # Consensus-kernel-only run: the nodes keep CPU crypto, so
             # compiling the verify shapes would be pure waste.
@@ -381,7 +381,14 @@ def main():
     parser.add_argument("--base-port", type=int, default=7000)
     parser.add_argument("--json", action="store_true")
     parser.add_argument("--crypto-backend", choices=["cpu", "tpu"], default=None)
-    parser.add_argument("--consensus-kernel", action="store_true")
+    parser.add_argument(
+        "--experimental-consensus-kernel",
+        dest="consensus_kernel",
+        action="store_true",
+        help="EXPERIMENTAL: run the committee with the device-resident "
+        "consensus kernel (correct but measured slower than the Python "
+        "walk; artifacts/consensus_bench_r06.json)",
+    )
     parser.add_argument(
         "--tpu-primaries",
         type=int,

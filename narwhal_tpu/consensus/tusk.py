@@ -67,19 +67,25 @@ class State:
         """Seed the committed frontier from snapshot_bytes output.
         Validation raises (never asserts — a malformed blob misparsed
         under ``python -O`` would silently wedge the commit rule at a
-        garbage frontier)."""
-        if blob[:6] != self._CKPT_MAGIC:
+        garbage frontier), and the WHOLE blob parses before any state
+        mutates: a torn checkpoint must leave the fresh frontier intact
+        so the caller can fall back to it (ADVICE.md r05)."""
+        if len(blob) < 18 or blob[:6] != self._CKPT_MAGIC:
             raise ValueError("checkpoint: bad magic")
-        (self.last_committed_round,) = struct.unpack_from("<Q", blob, 6)
+        (last_round,) = struct.unpack_from("<Q", blob, 6)
         (n,) = struct.unpack_from("<I", blob, 14)
         if len(blob) != 18 + 40 * n:
             raise ValueError("checkpoint: truncated or oversized blob")
+        entries = []
         pos = 18
         for _ in range(n):
             name = PublicKey(blob[pos : pos + 32])
             (round,) = struct.unpack_from("<Q", blob, pos + 32)
-            self.last_committed[name] = round
+            entries.append((name, round))
             pos += 40
+        self.last_committed_round = last_round
+        for name, round in entries:
+            self.last_committed[name] = round
 
     def update(self, certificate: Certificate, gc_depth: Round) -> None:
         """Record a commit and garbage-collect the DAG window."""
@@ -295,16 +301,29 @@ class Consensus:
         # is the backstop for the paths where it does.)
         self.checkpoint_path = checkpoint_path
         if checkpoint_path is not None and os.path.exists(checkpoint_path):
-            with open(checkpoint_path, "rb") as f:
-                self.tusk.state.restore(f.read())
-            if hasattr(self.tusk, "_win_shift"):
-                # Realign the kernel's dense window to the restored
-                # frontier (slot 0 == last_committed_round).
-                self.tusk._win_shift()
-            log.info(
-                "Restored consensus frontier at round %d",
-                self.tusk.state.last_committed_round,
-            )
+            try:
+                with open(checkpoint_path, "rb") as f:
+                    self.tusk.state.restore(f.read())
+            except Exception:
+                # A torn/corrupt checkpoint must not crash-loop the node:
+                # the file is a recovery OPTIMIZATION (restore validates
+                # before mutating, so the fresh frontier below is intact).
+                # Booting fresh is always safe — at worst already-committed
+                # certificates re-deliver, dedupable downstream by digest.
+                log.exception(
+                    "Checkpoint %s is corrupt or torn; IGNORING it and "
+                    "booting from a fresh consensus frontier",
+                    checkpoint_path,
+                )
+            else:
+                if hasattr(self.tusk, "_win_shift"):
+                    # Realign the kernel's dense window to the restored
+                    # frontier (slot 0 == last_committed_round).
+                    self.tusk._win_shift()
+                log.info(
+                    "Restored consensus frontier at round %d",
+                    self.tusk.state.last_committed_round,
+                )
 
     async def run(self) -> None:
         while True:
@@ -335,4 +354,10 @@ class Consensus:
                 tmp = self.checkpoint_path + ".tmp"
                 with open(tmp, "wb") as f:
                     f.write(self.tusk.state.snapshot_bytes())
+                    # fsync BEFORE the rename: os.replace is atomic against
+                    # process crash, but on power loss the rename can become
+                    # durable before the data, leaving a torn file under the
+                    # final name (ADVICE.md r05).
+                    f.flush()
+                    os.fsync(f.fileno())
                 os.replace(tmp, self.checkpoint_path)

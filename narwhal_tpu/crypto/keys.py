@@ -3,21 +3,46 @@
 Mirrors reference crypto/src/lib.rs:64-220: `PublicKey`/`SecretKey` newtypes
 with base64 serialization, deterministic keygen from a seeded RNG for test
 fixtures, and 64-byte signatures.  The CPU implementation rides the
-`cryptography` package (OpenSSL ed25519); the TPU batched verifier lives in
-`narwhal_tpu.ops.ed25519` behind `crypto.backend`.
+`cryptography` package (OpenSSL ed25519) when installed and falls back to
+the dependency-free pure-Python RFC 8032 signer (`_ed25519_py`) otherwise —
+same keys, signatures, and verify semantics, just slower per call.  The
+TPU batched verifier lives in `narwhal_tpu.ops.ed25519` behind
+`crypto.backend`.
 """
 
 from __future__ import annotations
 
 import base64
+import os
 from typing import Optional
 
-from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-    Ed25519PrivateKey,
-    Ed25519PublicKey,
-)
-from cryptography.exceptions import InvalidSignature
+try:
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+        Ed25519PublicKey,
+    )
+    from cryptography.exceptions import InvalidSignature
 
+    _HAVE_OPENSSL = True
+except ImportError:  # minimal container: pure-Python fallback
+    _HAVE_OPENSSL = False
+    import warnings
+
+    # Loud, once, at import: the fallback is correct but ~1000× slower and
+    # NOT constant-time (Python big-int scalar muls branch on secret
+    # nibbles).  A production image must ship the `cryptography` wheel —
+    # this downgrade should be a deliberate choice, never a silent
+    # accident of an incomplete build.
+    warnings.warn(
+        "narwhal_tpu.crypto: the `cryptography` package is not installed; "
+        "falling back to the pure-Python ed25519 signer (slow, "
+        "non-constant-time — fine for tests/benches, NOT for production "
+        "keys)",
+        RuntimeWarning,
+        stacklevel=2,
+    )
+
+from . import _ed25519_py
 from .digest import Digest
 
 
@@ -90,12 +115,19 @@ class KeyPair:
     Reference config/src/lib.rs:249-271 (KeyPair with JSON import/export).
     """
 
-    __slots__ = ("name", "secret", "_sk")
+    __slots__ = ("name", "secret", "_sk", "_py_expanded")
 
     def __init__(self, name: PublicKey, secret: SecretKey) -> None:
         self.name = name
         self.secret = secret
-        self._sk = Ed25519PrivateKey.from_private_bytes(secret)
+        if _HAVE_OPENSSL:
+            self._sk = Ed25519PrivateKey.from_private_bytes(secret)
+        else:
+            self._sk = None
+            # Cache the expanded scalar/prefix: repeated fallback signing
+            # then costs one base multiplication per call, not two.
+            a, prefix = _ed25519_py._secret_expand(bytes(secret))
+            self._py_expanded = (a, prefix, bytes(name))
 
     @classmethod
     def generate(cls, rng_seed: Optional[bytes] = None) -> "KeyPair":
@@ -103,18 +135,25 @@ class KeyPair:
         (the reference tests seed StdRng with [0;32],
         reference primary/src/tests/common.rs:29-32)."""
         if rng_seed is None:
-            sk = Ed25519PrivateKey.generate()
-            seed = sk.private_bytes_raw()
+            seed = os.urandom(32)
+        elif len(rng_seed) != 32:
+            raise ValueError("seed must be 32 bytes")
         else:
-            if len(rng_seed) != 32:
-                raise ValueError("seed must be 32 bytes")
             seed = rng_seed
+        if _HAVE_OPENSSL:
             sk = Ed25519PrivateKey.from_private_bytes(seed)
-        pk = sk.public_key().public_bytes_raw()
+            pk = sk.public_key().public_bytes_raw()
+        else:
+            pk = _ed25519_py.secret_to_public(seed)
         return cls(PublicKey(pk), SecretKey(seed))
 
     def sign(self, digest: Digest) -> Signature:
-        return Signature(self._sk.sign(bytes(digest)))
+        if self._sk is not None:
+            return Signature(self._sk.sign(bytes(digest)))
+        a, prefix, pub = self._py_expanded
+        return Signature(
+            _ed25519_py.sign_expanded(a, prefix, pub, bytes(digest))
+        )
 
     # --- JSON file import/export (reference config/src/lib.rs:28-56) ---
 
@@ -130,7 +169,10 @@ class KeyPair:
 
 
 def cpu_verify(message: bytes, key: PublicKey, signature: Signature) -> bool:
-    """Single strict-ish verification via OpenSSL."""
+    """Single strict-ish verification via OpenSSL (pure-Python RFC 8032
+    fallback when the `cryptography` package is absent)."""
+    if not _HAVE_OPENSSL:
+        return _ed25519_py.verify(bytes(key), bytes(message), bytes(signature))
     try:
         Ed25519PublicKey.from_public_bytes(bytes(key)).verify(
             bytes(signature), bytes(message)

@@ -85,8 +85,18 @@ async def send_load(target: str, size: int, rate: int, sample_offset: int = 0) -
         # Sample-send log BEFORE the write, so its timestamp excludes the
         # burst's own queueing (reference benchmark_client.rs:258-262).
         log.info("Sending sample transaction %d", counter)
-        writer.write(bytes(template))
-        await writer.drain()
+        try:
+            writer.write(bytes(template))
+            await writer.drain()
+        except OSError:
+            # The worker's tx socket went away — at a bench window's end
+            # the harness tears the committee down before the clients, and
+            # on a loaded host this client can observe the closed socket
+            # before its own SIGTERM lands.  An open-loop load generator
+            # outliving its server is a normal shutdown, not an error (a
+            # traceback here would hard-fail the log parser's error scan).
+            log.info("Worker connection closed; stopping load")
+            return
         counter += 1
         now = loop.time()
         if now > deadline:

@@ -51,10 +51,16 @@ def main(argv=None) -> int:
     run.add_argument("--store", required=True)
     run.add_argument("--benchmark", action="store_true", default=False)
     run.add_argument(
-        "--consensus-kernel",
+        "--experimental-consensus-kernel",
         action="store_true",
         default=False,
-        help="Run Tusk's order_leaders on the JAX device kernel",
+        help="EXPERIMENTAL: run Tusk's order_leaders on the JAX device "
+        "kernel (device-resident window, W-bit commit fetch).  Correct "
+        "(golden-tested cert-for-cert) but measured SLOWER than the "
+        "Python walk end-to-end on every host benchmarked so far "
+        "(artifacts/consensus_bench_r06.json) — excluded from the "
+        "default benchmark flag set until a host-local chip measures a "
+        "win; see README.md 'Consensus kernel'",
     )
     run.add_argument(
         "--crypto-backend",
@@ -77,7 +83,11 @@ def main(argv=None) -> int:
         "gotcha about wedged chip grants).",
     )
     warm.add_argument("--committee", required=True)
-    warm.add_argument("--consensus-kernel", action="store_true", default=False)
+    warm.add_argument(
+        "--experimental-consensus-kernel",
+        action="store_true",
+        default=False,
+    )
     warm.add_argument("--gc-depth", type=int, default=None)
     warm.add_argument(
         "--skip-verify",
@@ -107,7 +117,7 @@ def main(argv=None) -> int:
             log.info("Prewarming tpu verify backend...")
             backend.warmup(max_claims=derive_max_claims(committee))
             log.info("Verify backend ready")
-        if args.consensus_kernel:
+        if args.experimental_consensus_kernel:
             from ..ops.reachability import KernelTusk
 
             gc_depth = (
@@ -145,7 +155,7 @@ def main(argv=None) -> int:
                 parameters,
                 store_path=f"{args.store}/store.log",
                 benchmark=args.benchmark,
-                use_kernel=args.consensus_kernel,
+                use_kernel=args.experimental_consensus_kernel,
             )
         else:
             node = await spawn_worker_node(

@@ -184,6 +184,72 @@ def test_async_consensus_runner():
     asyncio.run(asyncio.wait_for(go(), 15))
 
 
+def test_restore_torn_blob_raises_without_mutation():
+    """A truncated/corrupt checkpoint must raise BEFORE any state mutates:
+    the caller's fallback is the fresh frontier, which must be intact
+    (ADVICE.md r05 — the old code assigned last_committed_round before
+    validating the length)."""
+    import pytest
+
+    c = committee()
+    names = sorted_names()
+    certs, next_parents = make_certificates(1, 4, genesis_digests(c), names)
+    _, trigger = mock_certificate(names[0], 5, next_parents)
+    tusk = Tusk(c, gc_depth=50, fixed_coin=True)
+    assert feed(tusk, certs + [trigger])
+    blob = tusk.state.snapshot_bytes()
+
+    fresh = Tusk(c, gc_depth=50, fixed_coin=True)
+    before_round = fresh.state.last_committed_round
+    before_map = dict(fresh.state.last_committed)
+    for bad in (blob[: len(blob) // 2], b"", b"JUNK!!" + blob[6:], blob[:17]):
+        with pytest.raises(ValueError):
+            fresh.state.restore(bad)
+        assert fresh.state.last_committed_round == before_round
+        assert fresh.state.last_committed == before_map
+
+
+def test_corrupt_checkpoint_boots_fresh_and_commits(tmp_path):
+    """A torn checkpoint file on disk must not crash-loop the node: the
+    Consensus boot logs loudly, ignores it, and commits from a fresh
+    frontier (the reference's behavior — it has no checkpoint at all)."""
+
+    async def go():
+        ckpt = str(tmp_path / "consensus.ckpt")
+        with open(ckpt, "wb") as f:
+            f.write(b"NCKPT1\x00\x01")  # torn mid-write
+
+        c = committee()
+        names = sorted_names()
+        certs, next_parents = make_certificates(1, 4, genesis_digests(c), names)
+        _, trigger = mock_certificate(names[0], 5, next_parents)
+        certs.append(trigger)
+
+        rx, tx_primary, tx_output = (
+            asyncio.Queue(),
+            asyncio.Queue(),
+            asyncio.Queue(),
+        )
+        consensus = Consensus(
+            c, 50, rx, tx_primary, tx_output,
+            fixed_coin=True, checkpoint_path=ckpt,
+        )
+        assert consensus.tusk.state.last_committed_round == 0  # fresh
+        task = asyncio.ensure_future(consensus.run())
+        for cert in certs:
+            await rx.put(cert)
+        out = [await asyncio.wait_for(tx_output.get(), 5) for _ in range(5)]
+        assert [x.round for x in out] == [1, 1, 1, 1, 2]
+        task.cancel()
+        # The commit rewrote the checkpoint: a restart now restores cleanly.
+        with open(ckpt, "rb") as f:
+            state = Tusk(c, gc_depth=50, fixed_coin=True).state
+            state.restore(f.read())
+        assert state.last_committed_round == 2
+
+    asyncio.run(asyncio.wait_for(go(), 15))
+
+
 def test_checkpoint_restore_resumes_without_redelivery():
     """Committed-frontier checkpointing (beyond reference parity —
     consensus/src/lib.rs:18-19 marks persisted consensus state as

@@ -78,3 +78,64 @@ def test_signature_service():
         assert verify(bytes(d), kp.name, sig)
 
     asyncio.run(go())
+
+
+def test_pure_python_ed25519_rfc8032_vectors():
+    """The dependency-free fallback signer (crypto/_ed25519_py) against
+    RFC 8032 §7.1 test vectors 1 and 3 — the ground truth that holds on
+    hosts with no OpenSSL to differential-test against."""
+    from narwhal_tpu.crypto import _ed25519_py as E
+
+    sk1 = bytes.fromhex(
+        "9d61b19deffd5a60ba844af492ec2cc44449c5697b326919703bac031cae7f60"
+    )
+    assert E.secret_to_public(sk1).hex() == (
+        "d75a980182b10ab7d54bfed3c964073a0ee172f3daa62325af021a68f707511a"
+    )
+    sig1 = E.sign(sk1, b"")
+    assert sig1.hex() == (
+        "e5564300c360ac729086e2cc806e828a84877f1eb8e5d974d873e06522490155"
+        "5fb8821590a33bacc61e39701cf9b46bd25bf5f0595bbe24655141438e7a100b"
+    )
+    assert E.verify(E.secret_to_public(sk1), b"", sig1)
+
+    sk3 = bytes.fromhex(
+        "c5aa8df43f9f837bedb7442f31dcb7b166d38535076f094b85ce3a2e0b4458f7"
+    )
+    msg3 = bytes.fromhex("af82")
+    assert E.secret_to_public(sk3).hex() == (
+        "fc51cd8e6218a1a38da47ed00230f0580816ed13ba3303ac5deb911548908025"
+    )
+    sig3 = E.sign(sk3, msg3)
+    assert sig3.hex() == (
+        "6291d657deec24024827e69c3abe01a30ce548a284743a445e3680d7db5ac3ac"
+        "18ff9b538d16f290ae67f760984dc6594a7c15e9716ed28dc027beceea1ec40a"
+    )
+    assert E.verify(E.secret_to_public(sk3), msg3, sig3)
+    # Rejections: tampered message, tampered sig, s >= L, bad point.
+    assert not E.verify(E.secret_to_public(sk3), b"x" + msg3, sig3)
+    assert not E.verify(E.secret_to_public(sk3), msg3, sig3[:32] + bytes(32))
+    s_ge_l = sig3[:32] + (E.L).to_bytes(32, "little")
+    assert not E.verify(E.secret_to_public(sk3), msg3, s_ge_l)
+    assert not E.verify(bytes(31) + b"\xff", msg3, sig3)
+
+
+def test_pure_python_ed25519_matches_openssl():
+    """Where OpenSSL is available, the fallback signer must produce
+    byte-identical signatures (ed25519 signing is deterministic) and agree
+    on verification."""
+    import pytest
+
+    cryptography = pytest.importorskip("cryptography")  # noqa: F841
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+    )
+    from narwhal_tpu.crypto import _ed25519_py as E
+
+    for seed_byte in (0, 7, 42):
+        seed = bytes([seed_byte]) * 32
+        sk = Ed25519PrivateKey.from_private_bytes(seed)
+        assert E.secret_to_public(seed) == sk.public_key().public_bytes_raw()
+        msg = b"message-%d" % seed_byte
+        assert E.sign(seed, msg) == sk.sign(msg)
+        assert E.verify(E.secret_to_public(seed), msg, sk.sign(msg))

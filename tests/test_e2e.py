@@ -436,7 +436,7 @@ def test_prewarm_cli(tmp_path, monkeypatch):
 
     try:
         rc = node_main(
-            ["prewarm", "--committee", path, "--consensus-kernel",
+            ["prewarm", "--committee", path, "--experimental-consensus-kernel",
              "--gc-depth", "4"]
         )
     finally:

@@ -217,17 +217,17 @@ def test_stall_beyond_window_falls_back_to_python():
 
     kernel_tusk = KernelTusk(c, gc_depth=6, fixed_coin=True)
     calls = []
-    real = R.leader_chain_scan
+    real = R.leader_commit_scan_counts
 
     def counting(*args, **kw):
         calls.append(args[-1] if not kw else kw.get("window"))
         return real(*args, **kw)
 
-    R.leader_chain_scan = counting
+    R.leader_commit_scan_counts = counting
     try:
         kernel = feed(kernel_tusk, all_certs)
     finally:
-        R.leader_chain_scan = real
+        R.leader_commit_scan_counts = real
 
     golden_same_depth = feed(Tusk(c, gc_depth=6, fixed_coin=True), all_certs)
     assert [x.digest() for x in kernel] == [
@@ -238,6 +238,152 @@ def test_stall_beyond_window_falls_back_to_python():
     # The kernel path did run after the stall, always at the static shape.
     assert calls, "kernel never used after catch-up"
     assert all(w == kernel_tusk.max_window for w in calls), calls
+
+
+def test_gc_window_wrap_equivalence():
+    """Continuous commits across 3× the static window: the device window
+    shifts (donated gather) on every commit and the total shift distance
+    wraps past W several times — the kernel must stay certificate-for-
+    certificate equal to the golden walk, without ever falling back."""
+    c = committee()
+    names = sorted_names()
+    gc_depth = 6  # W = 8
+    certs, _ = make_certificates(1, 30, genesis_digests(c), names)
+
+    golden = feed(Tusk(c, gc_depth=gc_depth, fixed_coin=True), certs)
+    kernel_tusk = KernelTusk(c, gc_depth=gc_depth, fixed_coin=True)
+    kernel = feed(kernel_tusk, certs)
+    assert [x.digest() for x in kernel] == [x.digest() for x in golden]
+    assert golden, "fixture must commit"
+    # Commits kept the span inside the window the whole way: the wrap was
+    # absorbed by shifts, not by Python fallbacks.
+    assert kernel_tusk.python_fallbacks == 0
+    assert kernel_tusk._win_base == kernel_tusk.state.last_committed_round
+    assert kernel_tusk._win_base > 3 * kernel_tusk.max_window - 10
+
+
+def test_multi_round_commit_burst_equivalence():
+    """Odd rounds delivered before even rounds: no arrival can trigger a
+    commit until one final trigger certificate, which then commits the
+    ENTIRE chain of linked leaders in one order_leaders call — a single
+    committed-bitmap fetch covering many leader rounds.  The inverted
+    delivery also floods the kernel's waiting-child repair (every even-
+    round parent arrives after its odd-round children)."""
+    c = committee()
+    names = sorted_names()
+    certs, parents = make_certificates(1, 16, genesis_digests(c), names)
+    # Odd rounds first (ascending), then even rounds: odd arrivals find no
+    # even-round leader in the DAG yet, even arrivals never trigger the
+    # commit check (r = round-1 must be even).
+    order = sorted(certs, key=lambda x: (x.round % 2 == 0, x.round))
+    _, trigger = mock_certificate(names[0], 17, parents)
+
+    golden = Tusk(c, gc_depth=50, fixed_coin=True)
+    kernel_tusk = KernelTusk(c, gc_depth=50, fixed_coin=True)
+    assert feed(golden, order) == []
+    assert feed(kernel_tusk, order) == []
+    got = kernel_tusk.process_certificate(trigger)
+    want = golden.process_certificate(trigger)
+    assert [x.digest() for x in got] == [x.digest() for x in want]
+    # The burst commits several leader rounds in one batch.
+    assert len({x.round for x in got if x.round % 2 == 0}) >= 3
+    assert kernel_tusk.python_fallbacks == 0
+
+
+def test_device_window_matches_dict_dag_rebuild():
+    """White-box: after a flush, the device-resident dense window must be
+    exactly the dense rendering of the dict DAG over [win_base,
+    win_base+W) — every certificate present, every resolved parent edge,
+    nothing else."""
+    import numpy as np
+
+    rng = random.Random(0xACE)
+    for trial in range(3):
+        certs = _random_dag_certs(rng, rounds=rng.randint(8, 18))
+        k = KernelTusk(committee(), gc_depth=50, fixed_coin=True)
+        feed(k, certs)
+        k._flush_pending()
+
+        W, n = k.max_window, k._n
+        base = k._win_base
+        want_exists = np.zeros((W, n), dtype=bool)
+        want_parent = np.zeros((W, n, n), dtype=bool)
+        digest_idx = {}
+        for r in range(base, base + W):
+            for name, (digest, cert) in k.state.dag.get(r, {}).items():
+                digest_idx[bytes(digest)] = (r, k._index[name])
+        for r in range(base, base + W):
+            for name, (digest, cert) in k.state.dag.get(r, {}).items():
+                w, i = r - base, k._index[name]
+                want_exists[w, i] = True
+                if w >= 1:
+                    for pd in cert.header.parents:
+                        pos = digest_idx.get(bytes(pd))
+                        if pos is not None and pos[0] == r - 1:
+                            want_parent[w, i, pos[1]] = True
+        assert ((np.asarray(k._dev_exists) > 0) == want_exists).all()
+        assert ((np.asarray(k._dev_parent) > 0) == want_parent).all()
+
+
+def test_arrival_path_stages_without_device_dispatch():
+    """The arrival path must be a bare staging append: no window_apply
+    dispatch until a commit opportunity flushes the batch."""
+    import narwhal_tpu.ops.reachability as R
+
+    c = committee()
+    names = sorted_names()
+    certs, _ = make_certificates(1, 3, genesis_digests(c), names)
+
+    k = KernelTusk(c, gc_depth=50, fixed_coin=True)
+    calls = []
+    real = R.window_apply
+
+    def counting(*args, **kw):
+        calls.append(1)
+        return real(*args, **kw)
+
+    R.window_apply = counting
+    try:
+        for cert in certs:
+            k.process_certificate(cert)  # rounds 1-3: no commit possible
+        assert calls == [], "insert path dispatched to the device"
+        assert len(k._pending) == len(certs) + len(genesis(c))
+        k._flush_pending()
+        assert len(calls) >= 1
+        assert k._pending == []
+    finally:
+        R.window_apply = real
+
+
+def test_kernel_restore_far_frontier_resets_window():
+    """Restore to a frontier ≥ W rounds ahead: _win_shift must take the
+    d ≥ W reset path (fresh zero buffers) and the kernel must then track
+    the golden instance on new rounds."""
+    c = committee()
+    names = sorted_names()
+    gc_depth = 6  # W = 8
+    certs, parents = make_certificates(1, 20, genesis_digests(c), names)
+    _, trigger = mock_certificate(names[0], 21, parents)
+
+    golden = Tusk(c, gc_depth=gc_depth, fixed_coin=True)
+    assert feed(golden, certs + [trigger])
+    blob = golden.state.snapshot_bytes()
+    assert golden.state.last_committed_round >= 8  # d >= W on restore
+
+    kernel = KernelTusk(c, gc_depth=gc_depth, fixed_coin=True)
+    kernel.state.restore(blob)
+    kernel._win_shift()  # what Consensus.__init__ does after a restore
+    assert kernel._win_base == golden.state.last_committed_round
+    # Catch-up replay of pre-crash history: nothing may be re-delivered.
+    assert feed(kernel, certs + [trigger]) == []
+
+    more, tail_parents = make_certificates(21, 26, parents, names)
+    more = more[1:]  # round-21 leader already exists as `trigger`
+    _, trigger2 = mock_certificate(names[0], 27, tail_parents)
+    got = feed(kernel, more + [trigger2])
+    want = feed(golden, more + [trigger2])
+    assert [x.digest() for x in got] == [x.digest() for x in want]
+    assert got
 
 
 def test_kernel_restore_resumes_like_golden():
