@@ -10,7 +10,7 @@
 
 PYTHON ?= python
 
-.PHONY: check native lint test test-ci bench clean
+.PHONY: check native lint test test-ci metrics-smoke bench clean
 
 check: native lint test
 
@@ -36,6 +36,14 @@ test-ci:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m 'not slow' \
 		--continue-on-collection-errors
 
+# Standalone in-process pipeline metrics test (4-node committee in one
+# process; asserts sealed==committed+dropped and monotonic stage stamps).
+# Dumps the final registry snapshot to .ci-artifacts/metrics-smoke.json,
+# which CI uploads as a workflow artifact.
+metrics-smoke: native
+	JAX_PLATFORMS=cpu NARWHAL_METRICS_DUMP=.ci-artifacts \
+		$(PYTHON) -m pytest tests/test_metrics_pipeline.py -x -q
+
 # The crypto differential suite under the float32 lane dtype (the default
 # run covers int32 + a narrow f32 subprocess check; run this after any
 # change to narwhal_tpu/ops/field25519.py or ed25519.py).
@@ -48,4 +56,4 @@ bench: native
 
 clean:
 	$(MAKE) -C native clean
-	rm -rf .bench .bench_remote .pytest_cache
+	rm -rf .bench .bench_remote .pytest_cache .ci-artifacts

@@ -196,6 +196,12 @@ def main() -> None:
                 "runs_e2e_tps": [round(r.end_to_end_tps, 1) for r in results],
                 "consensus_latency_ms": round(result.consensus_latency_ms, 1),
                 "end_to_end_latency_ms": round(result.end_to_end_latency_ms, 1),
+                # From the node metrics snapshots (narwhal_tpu/metrics.py):
+                # where the pipeline latency actually accrues, and the
+                # metrics-vs-log committed-tx cross-check of the median run.
+                "stages_ms": result.stages_ms,
+                "metrics_committed_tx": round(result.metrics_committed_tx, 1),
+                "metrics_disagreement": result.metrics_disagreement,
                 **({"errors": errors[:10]} if errors else {}),
                 **crypto,
             }

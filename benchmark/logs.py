@@ -31,7 +31,7 @@ from __future__ import annotations
 import re
 from dataclasses import dataclass, field
 from datetime import datetime
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 _TS = r"(\d{4}-\d{2}-\d{2}T\d{2}:\d{2}:\d{2}\.\d{3})Z"
 
@@ -50,6 +50,24 @@ _CONFIG_PATTERNS = [
 
 def _ts(s: str) -> float:
     return datetime.strptime(s, "%Y-%m-%dT%H:%M:%S.%f").timestamp()
+
+
+def _line_of(m: "re.Match") -> str:
+    """The full log line containing match `m`, truncated for error text."""
+    text = m.string
+    start = text.rfind("\n", 0, m.start()) + 1
+    end = text.find("\n", m.end())
+    line = text[start : end if end != -1 else len(text)]
+    return line[:200]
+
+
+def _named(logs: List[str], names: Optional[List[str]], prefix: str):
+    """Pair each log text with a human-readable source name, so every
+    parse error can say WHICH file broke (a mis-scrape used to cost a
+    full re-run to even locate)."""
+    if names and len(names) == len(logs):
+        return list(zip(names, logs))
+    return [(f"{prefix}[{i}]", text) for i, text in enumerate(logs)]
 
 
 class BenchError(Exception):
@@ -71,6 +89,13 @@ class ParseResult:
     rate_misses: int = 0
     config: Dict[str, int] = field(default_factory=dict)
     errors: List[str] = field(default_factory=list)
+    # Filled by the bench harness from node metrics snapshots (not by the
+    # log parser): metrics-derived committed-tx total, its disagreement
+    # with the log-scraped total (fraction, e.g. 0.012 = 1.2%), and the
+    # per-stage pipeline latency breakdown in milliseconds.
+    metrics_committed_tx: float = 0.0
+    metrics_disagreement: float | None = None
+    stages_ms: Dict[str, float] = field(default_factory=dict)
 
     def summary(self, rate: int, tx_size: int, nodes: int, workers: int) -> str:
         return (
@@ -105,51 +130,85 @@ def parse_logs(
     worker_logs: List[str],
     primary_logs: List[str],
     tx_size: int,
+    client_names: Optional[List[str]] = None,
+    worker_names: Optional[List[str]] = None,
+    primary_names: Optional[List[str]] = None,
 ) -> ParseResult:
+    """Parse node/client logs into a ParseResult.  The optional ``*_names``
+    lists label each log (file basenames from the harness) so every error
+    reports the offending source and a line excerpt instead of a bare
+    hard-fail."""
     result = ParseResult()
+    clients = _named(client_logs, client_names, "client")
+    workers = _named(worker_logs, worker_names, "worker")
+    primaries = _named(primary_logs, primary_names, "primary")
 
-    # Crash detection: any hard error in any log fails the run.
-    for text in client_logs + worker_logs + primary_logs:
+    def ts_of(m: "re.Match", source: str) -> Optional[float]:
+        """Timestamp of a matched line, or None with a located error.
+        The _TS regex makes this near-impossible to hit, but a mis-scrape
+        here used to cost a full re-run to even find the bad file."""
+        try:
+            return _ts(m.group(1))
+        except ValueError:
+            result.errors.append(
+                f"{source}: unparseable timestamp: {_line_of(m)}"
+            )
+            return None
+
+    # Crash detection: any hard error in any log fails the run — and names
+    # the log it came from.
+    for source, text in clients + workers + primaries:
         for marker in ("ERROR", "CRITICAL", "Traceback", "panicked"):
             if marker in text:
                 line = next(
                     (ln for ln in text.splitlines() if marker in ln), marker
                 )
-                result.errors.append(line)
+                result.errors.append(f"{source}: {line[:200]}")
 
     # Clients: start times, sample send times, missed-rate warnings.
     client_starts: List[float] = []
     sample_sent: Dict[int, float] = {}
-    for text in client_logs:
+    for source, text in clients:
         m = re.search(_TS + r".* Start sending transactions", text)
         if m:
-            client_starts.append(_ts(m.group(1)))
+            t = ts_of(m, source)
+            if t is not None:
+                client_starts.append(t)
         result.rate_misses += len(re.findall(r"rate too high", text))
         for m in re.finditer(_TS + r".* Sending sample transaction (\d+)", text):
-            sample_sent.setdefault(int(m.group(2)), _ts(m.group(1)))
+            t = ts_of(m, source)
+            if t is not None:
+                sample_sent.setdefault(int(m.group(2)), t)
 
     # Workers: batch sizes and contained samples.
     batch_bytes: Dict[str, int] = {}
     batch_samples: Dict[str, List[int]] = {}
-    for text in worker_logs:
+    for source, text in workers:
         for m in re.finditer(_TS + r".* Batch (\S+) contains (\d+) B", text):
             batch_bytes.setdefault(m.group(2), int(m.group(3)))
         for m in re.finditer(_TS + r".* Batch (\S+) contains sample tx (\d+)", text):
             batch_samples.setdefault(m.group(2), []).append(int(m.group(3)))
 
-    # Primaries: proposal (Created) and commit times, earliest across nodes.
+    # Primaries: proposal (Created) and commit times, earliest across
+    # nodes; remember one source per digest for error attribution.
     batch_proposed: Dict[str, float] = {}
     batch_committed: Dict[str, float] = {}
-    for text in primary_logs:
+    committed_source: Dict[str, str] = {}
+    for source, text in primaries:
         for m in re.finditer(_TS + r".* Created B\d+\(\S+\) -> (\S+)", text):
-            _merge_earliest(batch_proposed, m.group(2), _ts(m.group(1)))
+            t = ts_of(m, source)
+            if t is not None:
+                _merge_earliest(batch_proposed, m.group(2), t)
         for m in re.finditer(_TS + r".* Committed B\d+\(\S+\) -> (\S+)", text):
-            _merge_earliest(batch_committed, m.group(2), _ts(m.group(1)))
+            t = ts_of(m, source)
+            if t is not None:
+                _merge_earliest(batch_committed, m.group(2), t)
+                committed_source.setdefault(m.group(2), source)
 
     # Config echo-back verification (reference logs.py:109-131): every
     # primary log must carry the full parameter echo and all must agree.
     configs: List[Dict[str, int]] = []
-    for text in primary_logs:
+    for source, text in primaries:
         cfg = {}
         for key, pat in _CONFIG_PATTERNS:
             m = re.search(pat, text)
@@ -159,9 +218,26 @@ def parse_logs(
     if configs:
         complete = [c for c in configs if len(c) == len(_CONFIG_PATTERNS)]
         if len(complete) != len(configs):
-            result.errors.append("config echo missing from primary log(s)")
+            missing = [
+                f"{src} (missing "
+                f"{sorted(set(k for k, _ in _CONFIG_PATTERNS) - set(cfg))})"
+                for (src, _), cfg in zip(primaries, configs)
+                if len(cfg) != len(_CONFIG_PATTERNS)
+            ]
+            result.errors.append(
+                "config echo missing from primary log(s): "
+                + "; ".join(missing)
+            )
         elif any(c != configs[0] for c in configs):
-            result.errors.append("config echo differs between primaries")
+            diff = [
+                src
+                for (src, _), cfg in zip(primaries, configs)
+                if cfg != configs[0]
+            ]
+            result.errors.append(
+                "config echo differs between primaries: "
+                f"{diff} disagree with {primaries[0][0]}"
+            )
         else:
             result.config = configs[0]
 
@@ -175,9 +251,14 @@ def parse_logs(
     # Consensus: first proposal → last commit (reference logs.py:155-167).
     with_proposal = [d for d in committed if d in batch_proposed]
     if len(with_proposal) != len(committed):
+        orphans = [d for d in committed if d not in batch_proposed]
+        examples = ", ".join(
+            f"{d} (Committed in {committed_source.get(d, '?')})"
+            for d in orphans[:3]
+        )
         result.errors.append(
-            f"{len(committed) - len(with_proposal)} committed digest(s) "
-            "have no Created line in any primary log"
+            f"{len(orphans)} committed digest(s) "
+            f"have no Created line in any primary log; e.g. {examples}"
         )
     if with_proposal:
         start = min(batch_proposed[d] for d in with_proposal)

@@ -21,6 +21,7 @@ import os
 import struct
 from typing import Dict, List, Optional, Tuple
 
+from .. import metrics
 from ..config import Committee
 from ..crypto import Digest, PublicKey
 from ..messages import Round
@@ -282,6 +283,15 @@ class Consensus:
         self.tx_primary = tx_primary
         self.tx_output = tx_output
         self.benchmark = benchmark
+        self._m_certs_in = metrics.counter("consensus.certificates_in")
+        self._m_commits = metrics.counter("consensus.committed_certificates")
+        self._m_batches = metrics.counter("consensus.committed_batch_digests")
+        self._m_commit_batch = metrics.histogram(
+            "consensus.commit_batch_size", metrics.COUNT_BUCKETS
+        )
+        self._m_round = metrics.gauge("consensus.last_committed_round")
+        self._m_lag = metrics.gauge("consensus.commit_lag_rounds")
+        self._mtrace = metrics.trace()
         # Crash-recovery of the committed frontier (beyond reference
         # parity — it leaves consensus state unpersisted,
         # consensus/src/lib.rs:18-19).  The checkpoint is its own small
@@ -328,9 +338,25 @@ class Consensus:
     async def run(self) -> None:
         while True:
             certificate = await self.rx_primary.get()
+            self._m_certs_in.inc()
             sequence = self.tusk.process_certificate(certificate)
+            state = self.tusk.state
+            # Committed-certificate lag: how far the DAG head has run ahead
+            # of the committed frontier.  A steadily growing lag means the
+            # commit rule is starved (missing leader support) while
+            # certificates keep arriving.
+            self._m_lag.set(
+                max(0, certificate.round - state.last_committed_round)
+            )
+            self._m_round.set(state.last_committed_round)
+            if sequence:
+                self._m_commits.inc(len(sequence))
+                self._m_commit_batch.observe(len(sequence))
             for committed in sequence:
                 header = committed.header
+                self._m_batches.inc(len(header.payload))
+                for digest in header.payload:
+                    self._mtrace.mark(bytes(digest).hex(), "commit")
                 if self.benchmark and header.payload:
                     for digest in header.payload:
                         # Parsed by the benchmark log parser (reference

@@ -1,0 +1,571 @@
+"""In-process metrics: counters, gauges, histograms, and stage tracing.
+
+The reference ships no metrics at all — every number in its paper tables is
+scraped from four log lines (benchmark/benchmark/logs.py), and this repo
+inherited that: round 5's mis-measurement (32.6k tx/s at 3 s latency because
+queues silently flooded, VERDICT.md §1) had to be reconstructed from log
+archaeology.  This module is the first-class replacement: a dependency-free,
+near-zero-overhead per-process registry that every layer (worker, network,
+primary, consensus, store) writes into, plus
+
+- :class:`SnapshotWriter` — periodic atomic rewrite of one
+  ``metrics-<node>.json`` per process (write-temp + ``os.replace``, same
+  pattern as the consensus checkpoint), final snapshot flushed on cancel;
+- :class:`MetricsServer` — an optional Prometheus-text HTTP endpoint gated
+  behind ``--metrics-port`` (hand-rolled over ``asyncio.start_server``:
+  no http framework dependency);
+- :class:`TraceTable` — a bounded per-digest stage-timestamp table that
+  threads a sample-transaction trace through the whole pipeline
+  (batch-sealed → quorum → digest-at-primary → header → certificate →
+  committed), the per-stage latency breakdown the Narwhal paper uses to
+  argue the digest-only critical path.
+
+Hot-path cost model: a counter ``inc`` is one attribute add, a histogram
+``observe`` is one ``bisect`` + two adds; queue depths and sender backlogs
+are *callback* gauges evaluated only when a snapshot is taken, so the hot
+path never pays for them.  ``NARWHAL_METRICS=0`` swaps the whole registry
+for shared no-op instruments — the stub the bench harness uses to measure
+the instrumentation overhead itself.
+
+Everything here assumes the single-event-loop execution model of the node
+(like the Store): plain attribute updates need no locks.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import time
+from bisect import bisect_left
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+log = logging.getLogger("narwhal.metrics")
+
+# Latency buckets (seconds): 1 ms … 10 s, roughly log-spaced.  Chosen to
+# straddle the measured pipeline: quorum ACKs sit in the 1-50 ms range on
+# loopback, end-to-end commits in the 100 ms-3 s range (BASELINE.md).
+LATENCY_BUCKETS: Tuple[float, ...] = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+# Size/count buckets (e.g. KernelTusk flush batch sizes, queue bursts).
+COUNT_BUCKETS: Tuple[float, ...] = (
+    1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024,
+)
+
+# Pipeline stages, in causal order.  TraceTable.mark validates against this
+# so a typo'd stage name fails loudly in tests instead of silently skewing
+# the bench breakdown.
+STAGES: Tuple[str, ...] = (
+    "seal",               # worker: batch sealed (BatchMaker._seal)
+    "quorum",             # worker: 2f+1 ACK stake reached (QuorumWaiter)
+    "digest_at_primary",  # primary: own digest reached the Proposer
+    "header",             # primary: digest included in a created header
+    "cert",               # primary: own header's certificate assembled
+    "commit",             # consensus: containing header committed
+)
+
+
+class Counter:
+    """Monotone counter.  ``inc`` is the hot-path primitive: one add."""
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self.value = 0
+
+    def inc(self, n: int = 1) -> None:
+        self.value += n
+
+
+class Gauge:
+    """Point-in-time value, set by the instrumented code."""
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self.value = 0.0
+
+    def set(self, v: float) -> None:
+        self.value = v
+
+    def inc(self, n: float = 1) -> None:
+        self.value += n
+
+    def dec(self, n: float = 1) -> None:
+        self.value -= n
+
+
+class Histogram:
+    """Fixed-bucket histogram: count, sum, and per-bucket counts.
+
+    Buckets are upper bounds; values above the last bound land in the
+    implicit +Inf bucket.  Internal counts are per-bucket (not cumulative);
+    snapshots and the Prometheus rendering emit the cumulative form.
+    """
+
+    __slots__ = ("name", "bounds", "counts", "sum", "count")
+
+    def __init__(self, name: str, buckets: Sequence[float] = LATENCY_BUCKETS) -> None:
+        self.name = name
+        self.bounds: Tuple[float, ...] = tuple(buckets)
+        self.counts: List[int] = [0] * (len(self.bounds) + 1)  # +Inf last
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, v: float) -> None:
+        self.counts[bisect_left(self.bounds, v)] += 1
+        self.sum += v
+        self.count += 1
+
+    @property
+    def mean(self) -> float:
+        return self.sum / self.count if self.count else 0.0
+
+    def cumulative(self) -> List[Tuple[float, int]]:
+        """[(upper_bound, cumulative_count), ...] ending with (inf, count)."""
+        out, acc = [], 0
+        for bound, c in zip(self.bounds, self.counts):
+            acc += c
+            out.append((bound, acc))
+        out.append((float("inf"), self.count))
+        return out
+
+
+class TraceTable:
+    """Bounded digest → {stage: timestamp} table (plus per-digest extras
+    like the sealed byte count).
+
+    ``mark`` keeps the FIRST timestamp per (digest, stage) — matching the
+    log parser's earliest-across-nodes convention — and evicts the oldest
+    digests FIFO once ``cap`` is exceeded, so a long-lived node's table
+    stays bounded.  Timestamps are wall-clock (``time.time()``): the bench
+    joins stages across *processes* on the same host, which monotonic
+    clocks cannot do.
+    """
+
+    __slots__ = ("cap", "entries")
+
+    def __init__(self, cap: int = 32_768) -> None:
+        self.cap = cap
+        self.entries: Dict[str, Dict[str, float]] = {}
+
+    def mark(
+        self, digest_hex: str, stage: str, ts: Optional[float] = None, **extra
+    ) -> None:
+        if stage not in STAGES:
+            raise ValueError(f"unknown pipeline stage {stage!r}")
+        entry = self.entries.get(digest_hex)
+        if entry is None:
+            if len(self.entries) >= self.cap:
+                # FIFO eviction: dicts iterate in insertion order.
+                self.entries.pop(next(iter(self.entries)))
+            entry = self.entries[digest_hex] = {}
+        entry.setdefault(stage, ts if ts is not None else time.time())
+        for k, v in extra.items():
+            entry.setdefault(k, v)
+
+
+class _Null:
+    """Shared no-op instrument for the stubbed registry (NARWHAL_METRICS=0).
+    One class serves every instrument type: all mutators are no-ops and all
+    reads return zeros, so instrumented code needs no enabled-checks."""
+
+    __slots__ = ()
+    name = "null"
+    value = 0
+    sum = 0.0
+    count = 0
+    mean = 0.0
+    bounds: Tuple[float, ...] = ()
+    counts: List[int] = []
+    cap = 0
+    entries: Dict[str, Dict[str, float]] = {}
+
+    def inc(self, n=1) -> None: ...
+    def dec(self, n=1) -> None: ...
+    def set(self, v) -> None: ...
+    def observe(self, v) -> None: ...
+    def mark(self, digest_hex, stage, ts=None, **extra) -> None: ...
+    def cumulative(self) -> list: return []
+
+
+_NULL = _Null()
+
+
+class Registry:
+    """Per-process instrument registry.
+
+    Instruments are memoized by name (dotted ``layer.metric`` hierarchy),
+    so modules fetch them once at init and hold direct references — lookup
+    never sits on a hot path.  ``gauge_fn`` registers a zero-cost callback
+    gauge evaluated only at snapshot time (queue depths, sender backlogs);
+    ``detail_fn`` is the same but may return any JSON value (e.g. a
+    per-peer dict) and is excluded from the Prometheus rendering, which is
+    scalar-only.
+    """
+
+    def __init__(self, enabled: bool = True, trace_cap: int = 32_768) -> None:
+        self.enabled = enabled
+        self.counters: Dict[str, Counter] = {}
+        self.gauges: Dict[str, Gauge] = {}
+        self.histograms: Dict[str, Histogram] = {}
+        self.gauge_fns: Dict[str, Callable[[], float]] = {}
+        self.detail_fns: Dict[str, Callable[[], object]] = {}
+        self.trace: TraceTable = (
+            TraceTable(trace_cap) if enabled else _NULL  # type: ignore
+        )
+
+    def counter(self, name: str) -> Counter:
+        if not self.enabled:
+            return _NULL  # type: ignore[return-value]
+        c = self.counters.get(name)
+        if c is None:
+            c = self.counters[name] = Counter(name)
+        return c
+
+    def gauge(self, name: str) -> Gauge:
+        if not self.enabled:
+            return _NULL  # type: ignore[return-value]
+        g = self.gauges.get(name)
+        if g is None:
+            g = self.gauges[name] = Gauge(name)
+        return g
+
+    def histogram(
+        self, name: str, buckets: Sequence[float] = LATENCY_BUCKETS
+    ) -> Histogram:
+        if not self.enabled:
+            return _NULL  # type: ignore[return-value]
+        h = self.histograms.get(name)
+        if h is None:
+            h = self.histograms[name] = Histogram(name, buckets)
+        return h
+
+    def gauge_fn(self, name: str, fn: Callable[[], float]) -> None:
+        """Callback gauge, polled at snapshot/scrape time only.
+        Re-registration overwrites (in-process multi-node tests)."""
+        if self.enabled:
+            self.gauge_fns[name] = fn
+
+    def detail_fn(self, name: str, fn: Callable[[], object]) -> None:
+        """Like gauge_fn but may return structured JSON (snapshot only)."""
+        if self.enabled:
+            self.detail_fns[name] = fn
+
+    def reset(self) -> None:
+        """Zero every instrument IN PLACE (test isolation).  Module-level
+        code holds direct references fetched at import time (e.g. the
+        network counters), so instruments must keep their identity — only
+        their values reset.  Callback gauges are kept too: a callback over
+        a torn-down object fails in-band at snapshot time.  Production
+        code never calls this."""
+        for c in self.counters.values():
+            c.value = 0
+        for g in self.gauges.values():
+            g.value = 0.0
+        for h in self.histograms.values():
+            h.counts = [0] * (len(h.bounds) + 1)
+            h.sum = 0.0
+            h.count = 0
+        if self.enabled:
+            self.trace.entries.clear()
+
+    # -- export --------------------------------------------------------------
+
+    def snapshot(self, include_trace: bool = True) -> dict:
+        """One JSON-serializable dict of everything, callback gauges
+        evaluated now.  A failing callback is reported in-band (under
+        ``errors``) instead of killing the snapshot loop.
+
+        ``include_trace=False`` omits the stage-trace table — it dominates
+        the serialized size (hundreds of kB on a bench run, ~12 ms of
+        json.dumps on a slow core), and the periodic writer skips it on
+        most rewrites to keep the 1 Hz snapshot cost off the committee's
+        shared core."""
+        errors: List[str] = []
+
+        def call(name, fn):
+            try:
+                return fn()
+            except Exception as e:  # a dead queue/sender must not kill us
+                errors.append(f"{name}: {e!r}")
+                return None
+
+        snap = {
+            "ts": time.time(),
+            "pid": os.getpid(),
+            "enabled": self.enabled,
+            "counters": {n: c.value for n, c in self.counters.items()},
+            "gauges": {
+                **{n: g.value for n, g in self.gauges.items()},
+                **{n: call(n, fn) for n, fn in self.gauge_fns.items()},
+            },
+            "histograms": {
+                n: {
+                    "count": h.count,
+                    "sum": h.sum,
+                    "mean": h.mean,
+                    "buckets": [
+                        [b if b != float("inf") else "inf", c]
+                        for b, c in h.cumulative()
+                    ],
+                }
+                for n, h in self.histograms.items()
+            },
+            "detail": {n: call(n, fn) for n, fn in self.detail_fns.items()},
+            "trace": (
+                dict(self.trace.entries)
+                if self.enabled and include_trace
+                else {}
+            ),
+        }
+        if errors:
+            snap["errors"] = errors
+        return snap
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition (version 0.0.4).  Dotted names become
+        underscore-joined with a ``narwhal_`` prefix; counters get the
+        ``_total`` suffix, histograms the ``_bucket/_sum/_count`` triple."""
+
+        def mangle(name: str) -> str:
+            return "narwhal_" + name.replace(".", "_").replace("-", "_")
+
+        lines: List[str] = []
+        for n, c in sorted(self.counters.items()):
+            m = mangle(n) + "_total"
+            lines.append(f"# TYPE {m} counter")
+            lines.append(f"{m} {c.value}")
+        gauges = {n: g.value for n, g in self.gauges.items()}
+        for n, fn in self.gauge_fns.items():
+            try:
+                gauges[n] = fn()
+            except Exception:
+                continue
+        for n in sorted(gauges):
+            m = mangle(n)
+            lines.append(f"# TYPE {m} gauge")
+            lines.append(f"{m} {gauges[n]}")
+        for n, h in sorted(self.histograms.items()):
+            m = mangle(n)
+            lines.append(f"# TYPE {m} histogram")
+            for bound, acc in h.cumulative():
+                le = "+Inf" if bound == float("inf") else repr(float(bound))
+                lines.append(f'{m}_bucket{{le="{le}"}} {acc}')
+            lines.append(f"{m}_sum {h.sum}")
+            lines.append(f"{m}_count {h.count}")
+        return "\n".join(lines) + "\n"
+
+
+# -- the per-process default registry ----------------------------------------
+
+def _enabled_from_env() -> bool:
+    return os.environ.get("NARWHAL_METRICS", "1") != "0"
+
+
+_REGISTRY = Registry(
+    enabled=_enabled_from_env(),
+    trace_cap=int(os.environ.get("NARWHAL_TRACE_CAP", "32768")),
+)
+
+
+def registry() -> Registry:
+    return _REGISTRY
+
+
+def counter(name: str) -> Counter:
+    return _REGISTRY.counter(name)
+
+
+def gauge(name: str) -> Gauge:
+    return _REGISTRY.gauge(name)
+
+
+def histogram(name: str, buckets: Sequence[float] = LATENCY_BUCKETS) -> Histogram:
+    return _REGISTRY.histogram(name, buckets)
+
+
+def gauge_fn(name: str, fn: Callable[[], float]) -> None:
+    _REGISTRY.gauge_fn(name, fn)
+
+
+def detail_fn(name: str, fn: Callable[[], object]) -> None:
+    _REGISTRY.detail_fn(name, fn)
+
+
+def trace() -> TraceTable:
+    return _REGISTRY.trace  # type: ignore[return-value]
+
+
+# -- snapshot writer ----------------------------------------------------------
+
+class SnapshotWriter:
+    """Periodically rewrite ``path`` with the registry snapshot.
+
+    Atomic rewrite (write temp + ``os.replace``) so a reader — the bench
+    harness polling mid-run, or an operator's ``watch cat`` — never sees a
+    torn JSON document.  No fsync: the snapshot is an observability
+    artifact, not durable state (unlike the consensus checkpoint, losing
+    one interval to power loss costs nothing).  A final snapshot is
+    flushed on cancellation so teardown captures the complete run.
+
+    Cost control on the committee's shared core: counters/gauges/
+    histograms are a few kB and serialize in <1 ms every interval, but the
+    stage-trace table reaches hundreds of kB on a bench run (~12-22 ms of
+    json.dumps per rewrite on a slow core — measured to dent committee
+    TPS by ~10% at 1 Hz across 8 processes).  The trace is therefore
+    included only every ``trace_every``-th rewrite (staleness bounded at
+    ``trace_every × interval_s`` for a SIGKILLed node) and in the final
+    cancellation flush, which is what the bench cross-validation reads.
+    """
+
+    def __init__(
+        self,
+        reg: Registry,
+        path: str,
+        interval_s: float = 1.0,
+        trace_every: int = 10,
+    ) -> None:
+        self.registry = reg
+        self.path = path
+        self.interval_s = interval_s
+        self.trace_every = max(1, trace_every)
+        self._ticks = 0
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+
+    def write_once(self, include_trace: bool = True) -> None:
+        # Serialize to one string first: json.dump streams thousands of
+        # tiny f.write chunks (measured ~2× the dumps+single-write cost
+        # with a loaded trace table).
+        body = json.dumps(self.registry.snapshot(include_trace))
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(body)
+        os.replace(tmp, self.path)
+
+    async def run(self) -> None:
+        try:
+            while True:
+                await asyncio.sleep(self.interval_s)
+                self._ticks += 1
+                try:
+                    self.write_once(
+                        include_trace=(self._ticks % self.trace_every == 0)
+                    )
+                except OSError:
+                    # A transient write failure (ENOSPC clearing, tmp-dir
+                    # hiccup) must not kill the loop for the rest of the
+                    # run — the next interval retries.
+                    log.exception(
+                        "periodic metrics snapshot to %s failed", self.path
+                    )
+        finally:
+            # Teardown flush: the harness reads post-mortem totals and the
+            # full stage trace from this final write (cross-validation
+            # needs the whole run, not the last whole interval).
+            try:
+                self.write_once(include_trace=True)
+            except OSError:
+                log.exception("final metrics snapshot to %s failed", self.path)
+
+
+# -- Prometheus-text HTTP endpoint --------------------------------------------
+
+class MetricsServer:
+    """Minimal HTTP server: ``GET /metrics`` → Prometheus text,
+    ``GET /metrics.json`` → the JSON snapshot.  Anything else is 404.
+
+    Hand-rolled over ``asyncio.start_server`` — the container bakes no
+    http framework, and a scrape endpoint needs exactly one request per
+    connection (Connection: close).
+
+    Binds localhost by default: the endpoint is unauthenticated (and the
+    snapshot's detail section names peer addresses), so it follows the
+    same convention as every other listener here — NARWHAL_BIND_ANY=1
+    widens it to 0.0.0.0 for scrapers on other hosts (receiver.py)."""
+
+    def __init__(self, reg: Registry) -> None:
+        self.registry = reg
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    @classmethod
+    async def spawn(
+        cls, reg: Registry, port: int, host: Optional[str] = None
+    ) -> "MetricsServer":
+        if host is None:
+            host = (
+                "0.0.0.0"
+                if os.environ.get("NARWHAL_BIND_ANY") == "1"
+                else "127.0.0.1"
+            )
+        self = cls(reg)
+        self._server = await asyncio.start_server(self._handle, host, port)
+        log.info("Metrics endpoint listening on %s:%d", host, self.port)
+        return self
+
+    @property
+    def port(self) -> int:
+        assert self._server is not None
+        return self._server.sockets[0].getsockname()[1]
+
+    async def _handle(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        try:
+            request = await asyncio.wait_for(reader.readline(), timeout=5.0)
+            parts = request.decode("latin-1", "replace").split()
+            target = parts[1] if len(parts) >= 2 else ""
+            # Drain the header block (ignored) so the client sees a clean
+            # close instead of a reset.  Bounded: a client streaming
+            # endless garbage lines must not pin this handler forever.
+            for _ in range(100):
+                line = await asyncio.wait_for(reader.readline(), timeout=5.0)
+                if line in (b"\r\n", b"\n", b""):
+                    break
+            else:
+                return  # header flood; drop the connection
+            if target == "/metrics":
+                body = self.registry.render_prometheus().encode()
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+                status = "200 OK"
+            elif target == "/metrics.json":
+                body = json.dumps(self.registry.snapshot()).encode()
+                ctype = "application/json"
+                status = "200 OK"
+            else:
+                body = b"not found\n"
+                ctype = "text/plain"
+                status = "404 Not Found"
+            writer.write(
+                (
+                    f"HTTP/1.1 {status}\r\n"
+                    f"Content-Type: {ctype}\r\n"
+                    f"Content-Length: {len(body)}\r\n"
+                    "Connection: close\r\n\r\n"
+                ).encode()
+                + body
+            )
+            await writer.drain()
+        except (asyncio.TimeoutError, ConnectionError, OSError, ValueError):
+            # ValueError: readline() on an over-long request/header line
+            # (stream limit overrun) — scraping garbage must not leave an
+            # unhandled-task ERROR in a benchmarked node's log.
+            pass
+        finally:
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+    async def shutdown(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None

@@ -10,6 +10,7 @@ import logging
 import os
 from typing import Protocol
 
+from .. import metrics
 from .framing import (
     STREAM_LIMIT,
     FrameError,
@@ -19,7 +20,11 @@ from .framing import (
     write_frame,
 )
 
-log = logging.getLogger(__name__)
+log = logging.getLogger("narwhal.network")
+
+_m_frames_in = metrics.counter("net.recv.frames")
+_m_bytes_in = metrics.counter("net.recv.bytes")
+_m_bad_frames = metrics.counter("net.recv.bad_frames")
 
 
 class Writer:
@@ -95,10 +100,13 @@ class Receiver:
         try:
             while True:
                 message = await read_frame(reader)
+                _m_frames_in.inc()
+                _m_bytes_in.inc(len(message))
                 await self.handler.dispatch(w, message)
         except (asyncio.IncompleteReadError, ConnectionError):
             pass  # peer closed
         except FrameError as e:
+            _m_bad_frames.inc()
             log.warning("Bad frame from %s: %s", peer, e)
         except Exception:
             log.exception("Handler error for peer %s", peer)

@@ -14,8 +14,10 @@ from __future__ import annotations
 import asyncio
 import collections
 import logging
+import weakref
 from typing import Deque, Dict, List, Sequence, Tuple
 
+from .. import metrics
 from .framing import (
     MAX_FRAME,
     STREAM_LIMIT,
@@ -26,12 +28,48 @@ from .framing import (
     write_frame,
 )
 
-log = logging.getLogger(__name__)
+log = logging.getLogger("narwhal.network")
 
 _BACKOFF_START = 0.2
 _BACKOFF_CAP = 60.0
 
 _Item = Tuple[bytes, asyncio.Future]
+
+# Counters are shared by every ReliableSender in the process (one registry
+# per process); the per-peer detail below disaggregates when needed.
+_m_frames = metrics.counter("net.reliable.frames_sent")
+_m_bytes = metrics.counter("net.reliable.bytes_sent")
+_m_retrans = metrics.counter("net.reliable.retransmissions")
+_m_connect_fail = metrics.counter("net.reliable.connect_failures")
+_m_acks = metrics.counter("net.reliable.acks_received")
+
+# Live senders, for snapshot-time gauges: total un-ACKed backlog and how
+# many peer connections are currently in reconnect backoff.  WeakSet so a
+# closed sender's state stops being reported once collected.
+_SENDERS: "weakref.WeakSet[ReliableSender]" = weakref.WeakSet()
+
+
+def _connections():
+    for sender in _SENDERS:
+        yield from sender._connections.values()
+
+
+metrics.gauge_fn(
+    "net.reliable.pending_acks",
+    lambda: sum(len(c.pending) + len(c.buffer) for c in _connections()),
+)
+metrics.gauge_fn(
+    "net.reliable.peers_backing_off",
+    lambda: sum(1 for c in _connections() if c.backing_off),
+)
+metrics.detail_fn(
+    "net.reliable.pending_by_peer",
+    lambda: {
+        c.address: len(c.pending) + len(c.buffer)
+        for c in _connections()
+        if c.pending or c.buffer
+    },
+)
 
 
 class _Connection:
@@ -50,6 +88,7 @@ class _Connection:
         self.buffer: Deque[_Item] = collections.deque()
         self.pending: Deque[_Item] = collections.deque()
         self.wakeup = asyncio.Event()
+        self.backing_off = False  # reconnect backoff state (metrics gauge)
         self.task = asyncio.get_running_loop().create_task(self._keep_alive())
 
     def push(self, data: bytes, fut: asyncio.Future) -> None:
@@ -71,6 +110,9 @@ class _Connection:
             item = self.pending.pop()
             if not item[1].cancelled():
                 self.buffer.appendleft(item)
+                # Written once, un-ACKed, will be written again: that is a
+                # retransmission, the signal a flapping/slow peer leaves.
+                _m_retrans.inc()
 
     async def _keep_alive(self) -> None:
         host, port = parse_address(self.address)
@@ -84,10 +126,13 @@ class _Connection:
                     tune_writer(writer)
                 except OSError as e:
                     log.debug("ReliableSender: cannot reach %s: %s", self.address, e)
+                    _m_connect_fail.inc()
+                    self.backing_off = True
                     await asyncio.sleep(delay)
                     delay = min(delay * 2, _BACKOFF_CAP)
                     continue
                 delay = _BACKOFF_START
+                self.backing_off = False
                 try:
                     await self._exchange(reader, writer)
                 except (ConnectionError, OSError, asyncio.IncompleteReadError) as e:
@@ -114,12 +159,18 @@ class _Connection:
                     # than losing the message and wedging its future.
                     self.pending.append((data, fut))
                     await write_frame(writer, data)
+                    # Counted after the write returns (same convention as
+                    # SimpleSender): a frame lost to a mid-write disconnect
+                    # is not "sent" — its rewrite after reconnect is.
+                    _m_frames.inc()
+                    _m_bytes.inc(len(data))
                 self.wakeup.clear()
                 await self.wakeup.wait()
 
         async def read_loop() -> None:
             while True:
                 ack = await read_frame(reader)
+                _m_acks.inc()
                 # Exactly one pending entry per ACK frame — the peer ACKs
                 # everything we wrote, including since-cancelled messages.
                 if self.pending:
@@ -146,6 +197,7 @@ class _Connection:
 class ReliableSender:
     def __init__(self) -> None:
         self._connections: Dict[str, _Connection] = {}
+        _SENDERS.add(self)
 
     def _connection(self, address: str) -> _Connection:
         conn = self._connections.get(address)

@@ -11,6 +11,7 @@ import asyncio
 import logging
 from typing import Dict, Sequence
 
+from .. import metrics
 from .framing import (
     STREAM_LIMIT,
     parse_address,
@@ -20,9 +21,13 @@ from .framing import (
     write_frame,
 )
 
-log = logging.getLogger(__name__)
+log = logging.getLogger("narwhal.network")
 
 _QUEUE_CAP = 1_000
+
+_m_frames = metrics.counter("net.simple.frames_sent")
+_m_bytes = metrics.counter("net.simple.bytes_sent")
+_m_dropped = metrics.counter("net.simple.dropped")
 
 
 class _Peer:
@@ -42,6 +47,7 @@ class _Peer:
                 tune_writer(writer)
             except OSError as e:
                 log.debug("SimpleSender: cannot reach %s: %s", self.address, e)
+                _m_dropped.inc()
                 continue  # drop this message; try fresh on the next one
             # Drain-and-discard replies (e.g. ACKs) so the peer's writes
             # don't stall; best-effort senders ignore response content.
@@ -49,8 +55,14 @@ class _Peer:
             try:
                 while True:
                     await write_frame(writer, data)
+                    # Counted only after the write succeeds; the failure
+                    # path below counts the in-flight message as dropped
+                    # (this sender's whole contract is visible loss).
+                    _m_frames.inc()
+                    _m_bytes.inc(len(data))
                     data = await self.queue.get()
             except (ConnectionError, OSError) as e:
+                _m_dropped.inc()
                 log.debug("SimpleSender: lost %s: %s", self.address, e)
             finally:
                 drain.cancel()
@@ -77,6 +89,7 @@ class SimpleSender:
         try:
             peer.queue.put_nowait(data)
         except asyncio.QueueFull:
+            _m_dropped.inc()
             log.warning("SimpleSender: queue full for %s; dropping", address)
 
     def broadcast(self, addresses: Sequence[str], data: bytes) -> None:

@@ -20,8 +20,18 @@ from ..crypto import KeyPair
 from .node import spawn_primary_node, spawn_worker_node
 
 
-def setup_logging(verbosity: int) -> None:
-    level = [logging.ERROR, logging.INFO, logging.DEBUG][min(verbosity, 2)]
+def setup_logging(verbosity: int, level_name: str | None = None) -> None:
+    # Explicit --log-level (or the NARWHAL_LOG env var) wins over -v; the
+    # level is applied to the whole `narwhal.*` hierarchy — every module
+    # logs under it (narwhal.worker, narwhal.primary, narwhal.consensus,
+    # narwhal.network, narwhal.node, narwhal.client, narwhal.metrics).
+    level_name = level_name or os.environ.get("NARWHAL_LOG")
+    if level_name:
+        level = getattr(logging, level_name.upper(), None)
+        if not isinstance(level, int):
+            raise SystemExit(f"unknown log level {level_name!r}")
+    else:
+        level = [logging.ERROR, logging.INFO, logging.DEBUG][min(verbosity, 2)]
     # Millisecond timestamps: the benchmark log parser depends on them
     # (reference main.rs:54-55).
     logging.basicConfig(
@@ -31,6 +41,7 @@ def setup_logging(verbosity: int) -> None:
         stream=sys.stderr,
         force=True,
     )
+    logging.getLogger("narwhal").setLevel(level)
 
 
 def main(argv=None) -> int:
@@ -39,6 +50,14 @@ def main(argv=None) -> int:
         description="A TPU-native implementation of Narwhal and Tusk.",
     )
     parser.add_argument("-v", action="count", default=1, dest="verbosity")
+    parser.add_argument(
+        "--log-level",
+        choices=["debug", "info", "warning", "error", "critical"],
+        default=None,
+        help="Log level for the whole narwhal.* hierarchy (overrides -v; "
+        "the NARWHAL_LOG env var is the equivalent knob for harnesses "
+        "that cannot edit the command line)",
+    )
     sub = parser.add_subparsers(dest="command", required=True)
 
     gen = sub.add_parser("generate_keys", help="Print a fresh keypair to file")
@@ -67,6 +86,26 @@ def main(argv=None) -> int:
         choices=["cpu", "tpu"],
         default=None,
         help="Signature verification backend (default: cpu)",
+    )
+    run.add_argument(
+        "--metrics-path",
+        default=None,
+        help="Write a JSON metrics snapshot (atomic rewrite) to this path "
+        "every --metrics-interval seconds, plus a final one at shutdown. "
+        "Unset = no snapshot file.",
+    )
+    run.add_argument(
+        "--metrics-interval",
+        type=float,
+        default=1.0,
+        help="Seconds between metrics snapshot rewrites (default 1.0)",
+    )
+    run.add_argument(
+        "--metrics-port",
+        type=int,
+        default=0,
+        help="Serve Prometheus text metrics on this port (GET /metrics; "
+        "GET /metrics.json for the snapshot form).  0 = disabled.",
     )
     runsub = run.add_subparsers(dest="role", required=True)
     runsub.add_parser("primary", help="Run a single primary")
@@ -105,7 +144,7 @@ def main(argv=None) -> int:
         return 0
 
     if args.command == "prewarm":
-        setup_logging(args.verbosity)
+        setup_logging(args.verbosity, args.log_level)
         log = logging.getLogger("narwhal.node")
         committee = Committee.load(args.committee)
         if not args.skip_verify:
@@ -130,7 +169,7 @@ def main(argv=None) -> int:
             log.info("Consensus kernel ready")
         return 0
 
-    setup_logging(args.verbosity)
+    setup_logging(args.verbosity, args.log_level)
     keypair = load_keypair(args.keys)
     committee = Committee.load(args.committee)
     parameters = (
@@ -148,6 +187,26 @@ def main(argv=None) -> int:
         # the logs with spurious exceptions the bench parser flags).
         stop = asyncio.Event()
         asyncio.get_running_loop().add_signal_handler(signal.SIGTERM, stop.set)
+
+        # Observability plane: periodic JSON snapshots and/or the
+        # Prometheus endpoint.  Both read the same per-process registry.
+        from .. import metrics as _metrics
+
+        snapshot_task = None
+        metrics_server = None
+        if args.metrics_path:
+            snapshot_task = asyncio.get_running_loop().create_task(
+                _metrics.SnapshotWriter(
+                    _metrics.registry(),
+                    args.metrics_path,
+                    interval_s=args.metrics_interval,
+                ).run()
+            )
+        if args.metrics_port:
+            metrics_server = await _metrics.MetricsServer.spawn(
+                _metrics.registry(), args.metrics_port
+            )
+
         if args.role == "primary":
             node = await spawn_primary_node(
                 keypair,
@@ -170,6 +229,13 @@ def main(argv=None) -> int:
             await stop.wait()  # run until SIGTERM/SIGINT
         finally:
             await node.shutdown()
+            if metrics_server is not None:
+                await metrics_server.shutdown()
+            if snapshot_task is not None:
+                # Cancellation triggers the writer's final flush, so the
+                # snapshot on disk covers the whole run.
+                snapshot_task.cancel()
+                await asyncio.gather(snapshot_task, return_exceptions=True)
 
     # NARWHAL_PROFILE=<dir>: cProfile the whole node, dumping stats on
     # SIGTERM (the harness sends SIGTERM before SIGKILL for this reason).

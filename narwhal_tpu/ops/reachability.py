@@ -50,8 +50,20 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .. import metrics
+
 
 _donation_warning_handled = False
+
+# Kernel observability: how large the staged flush batches are and how
+# many device dispatches the commit path actually issues — the numbers the
+# r05→r06 rebuild had to reconstruct from ad-hoc prints.
+_m_flush_batch = metrics.histogram(
+    "consensus.kernel.flush_batch_size", metrics.COUNT_BUCKETS
+)
+_m_dispatches = metrics.counter("consensus.kernel.dispatches")
+_m_shifts = metrics.counter("consensus.kernel.window_shifts")
+_m_fallbacks = metrics.counter("consensus.kernel.python_fallbacks")
 
 
 def _silence_cpu_donation_warning() -> None:
@@ -366,6 +378,7 @@ class KernelTusk(Tusk):
         if not self._pending:
             return
         pending, self._pending = self._pending, []
+        _m_flush_batch.observe(len(pending))
         # Parents (round r-1) before children (round r) within one flush;
         # cross-flush out-of-order arrivals go through the waiting map.
         pending.sort(key=lambda c: c.round)
@@ -441,6 +454,7 @@ class KernelTusk(Tusk):
             rv[j, prow] = 1
         for k in range(chunks):
             sl = slice(k * C, (k + 1) * C)
+            _m_dispatches.inc()
             self._dev_exists, self._dev_parent = window_apply(
                 self._dev_exists,
                 self._dev_parent,
@@ -463,6 +477,7 @@ class KernelTusk(Tusk):
             self._dev_exists = jnp.zeros((W, self._n), dtype=jnp.int32)
             self._dev_parent = jnp.zeros((W, self._n, self._n), dtype=jnp.int32)
         else:
+            _m_shifts.inc()
             self._dev_exists, self._dev_parent = window_shift_op(
                 self._dev_exists, self._dev_parent, jnp.int32(d), W
             )
@@ -522,6 +537,7 @@ class KernelTusk(Tusk):
         window = self.max_window
         if span > window or base != self._win_base:
             self.python_fallbacks += 1
+            _m_fallbacks.inc()
             return super().order_leaders(leader)
 
         self._flush_pending()

@@ -16,6 +16,7 @@ import asyncio
 import logging
 from typing import Dict, List, Set
 
+from .. import metrics
 from ..config import Committee
 from ..crypto import Digest, PublicKey, SignatureService
 from ..messages import Round
@@ -93,6 +94,14 @@ class Core:
         self.certificates_aggregators: Dict[Round, CertificatesAggregator] = {}
         self.network = ReliableSender()
         self.cancel_handlers: Dict[Round, List[asyncio.Future]] = {}
+        self._m_headers_in = metrics.counter("primary.headers_processed")
+        self._m_votes_in = metrics.counter("primary.votes_received")
+        self._m_votes_out = metrics.counter("primary.votes_sent")
+        self._m_certs_formed = metrics.counter("primary.certificates_formed")
+        self._m_certs_in = metrics.counter("primary.certificates_processed")
+        self._m_dag_errors = metrics.counter("primary.dag_errors")
+        self._m_stale = metrics.counter("primary.stale_messages")
+        self._mtrace = metrics.trace()
 
     # --- processing ---------------------------------------------------------
 
@@ -108,6 +117,7 @@ class Core:
 
     async def process_header(self, header: Header) -> None:
         log.debug("Processing %r", header)
+        self._m_headers_in.inc()
         self.processing.setdefault(header.round, set()).add(header.id)
 
         # Ensure we have all parents; otherwise the HeaderWaiter will gather
@@ -142,6 +152,7 @@ class Core:
         if header.author not in voted:
             voted.add(header.author)
             vote = await Vote.new(header, self.name, self.signature_service)
+            self._m_votes_out.inc()
             log.debug("Created %r", vote)
             if vote.origin == self.name:
                 await self.process_vote(vote)
@@ -152,11 +163,17 @@ class Core:
 
     async def process_vote(self, vote: Vote) -> None:
         log.debug("Processing %r", vote)
+        self._m_votes_in.inc()
         certificate = self.votes_aggregator.append(
             vote, self.committee, self.current_header
         )
         if certificate is not None:
             log.debug("Assembled %r", certificate)
+            self._m_certs_formed.inc()
+            # Stage trace: OUR header just got certified — the payload
+            # digests it carries cross the header→certificate boundary.
+            for digest in certificate.header.payload:
+                self._mtrace.mark(bytes(digest).hex(), "cert")
             addresses = [
                 a.primary_to_primary
                 for _, a in self.committee.others_primaries(self.name)
@@ -169,6 +186,7 @@ class Core:
 
     async def process_certificate(self, certificate: Certificate) -> None:
         log.debug("Processing %r", certificate)
+        self._m_certs_in.inc()
 
         # Process the embedded header if we haven't (certified ⇒ its data is
         # retrievable, so processing may proceed regardless).
@@ -267,8 +285,10 @@ class Core:
             elif source == "proposer":
                 await self.process_own_header(item)
         except TooOld as e:
+            self._m_stale.inc()
             log.debug("%s", e)
         except DagError as e:
+            self._m_dag_errors.inc()
             log.warning("%s", e)
 
         # GC internal per-round state from the shared consensus round.

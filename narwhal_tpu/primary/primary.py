@@ -13,6 +13,7 @@ import asyncio
 import logging
 from typing import List
 
+from .. import metrics
 from ..config import Committee, Parameters
 from ..crypto import KeyPair, SignatureService
 from ..messages import decode_worker_primary_message
@@ -111,7 +112,24 @@ class Primary:
         tx_proposer = q()  # core → proposer (parents, round)
         tx_own_headers = q()  # proposer → core
 
+        # Queue-depth gauges, polled only at snapshot/scrape time.
+        for gname, gq in (
+            ("primary.queue.primaries", tx_primaries),
+            ("primary.queue.helper", tx_helper),
+            ("primary.queue.our_digests", rx_our_digests),
+            ("primary.queue.others_digests", rx_others_digests),
+            ("primary.queue.header_waiter", tx_headers_loopback),
+            ("primary.queue.cert_waiter", tx_certs_loopback),
+            ("primary.queue.proposer", tx_proposer),
+            ("primary.queue.own_headers", tx_own_headers),
+            ("primary.queue.consensus", tx_consensus),
+        ):
+            metrics.gauge_fn(gname, gq.qsize)
+
         consensus_round = AtomicRound()
+        metrics.gauge_fn(
+            "primary.consensus_round", lambda: consensus_round.value
+        )
         signature_service = SignatureService(keypair)
         synchronizer = Synchronizer(
             name, committee, store, tx_headers_sync, tx_certs_sync

@@ -15,6 +15,7 @@ from typing import List, Tuple
 
 _TRACE = bool(os.environ.get("NARWHAL_TRACE"))
 
+from .. import metrics
 from ..config import Committee, WorkerId
 from ..crypto import Digest, PublicKey, SignatureService
 from ..messages import Round
@@ -49,6 +50,10 @@ class Proposer:
         self.last_parents: List[Digest] = [c.digest() for c in genesis(committee)]
         self.digests: List[Tuple[Digest, WorkerId]] = []
         self.payload_size = 0
+        self._m_headers = metrics.counter("primary.headers_proposed")
+        self._m_payload_digests = metrics.counter("primary.payload_digests")
+        self._m_round = metrics.gauge("primary.round")
+        self._mtrace = metrics.trace()
 
     async def _make_header(self) -> None:
         payload = dict(self.digests)
@@ -58,6 +63,10 @@ class Proposer:
             self.name, self.round, payload, parents, self.signature_service
         )
         log.debug("Created %r", header)
+        self._m_headers.inc()
+        self._m_payload_digests.inc(len(payload))
+        for digest in payload:
+            self._mtrace.mark(bytes(digest).hex(), "header")
         if self.benchmark:
             for digest in header.payload:
                 # Parsed by the benchmark log parser to attribute batches to
@@ -97,6 +106,7 @@ class Proposer:
                     if round >= self.round:
                         # Advance to the next round.
                         self.round = round + 1
+                        self._m_round.set(self.round)
                         log.debug("Dag moved to round %d", self.round)
                         self.last_parents = parents
                 if workers_get in done:
@@ -104,6 +114,7 @@ class Proposer:
                     workers_get = loop.create_task(self.rx_workers.get())
                     if _TRACE:
                         log.info("TRACE payload arrived %r", digest)
+                    self._mtrace.mark(bytes(digest).hex(), "digest_at_primary")
                     self.payload_size += len(digest)
                     self.digests.append((digest, worker_id))
         finally:

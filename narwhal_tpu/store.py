@@ -25,9 +25,25 @@ from __future__ import annotations
 import asyncio
 import os
 import struct
+import weakref
 from typing import Dict, List, Optional
 
+from . import metrics
+
 _REC = struct.Struct("<II")  # key length, value length
+
+_m_puts = metrics.counter("store.puts")
+_m_put_bytes = metrics.counter("store.put_bytes")
+_m_gets = metrics.counter("store.gets")
+
+# Parked notify_read obligations across every live store in the process —
+# the depth of the dependency-resolution machinery (sync/recovery stalls
+# show up here first).
+_STORES: "weakref.WeakSet[Store]" = weakref.WeakSet()
+metrics.gauge_fn(
+    "store.parked_obligations",
+    lambda: sum(len(s._obligations) for s in _STORES),
+)
 
 
 class Store:
@@ -37,6 +53,7 @@ class Store:
         self._fd: Optional[int] = None
         self._size = 0  # valid log length (single writer: we own the file)
         self._failed = False  # log lost its record boundary; writes refuse
+        _STORES.add(self)
         if path is not None:
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
             if os.path.exists(path):
@@ -109,6 +126,8 @@ class Store:
                         self._fd = None
                 raise
             self._size += total
+        _m_puts.inc()
+        _m_put_bytes.inc(len(key) + len(value))
         self._map[key] = value
         # Wake every parked notify_read on this key.
         waiters = self._obligations.pop(key, None)
@@ -118,6 +137,7 @@ class Store:
                     fut.set_result(value)
 
     def read(self, key: bytes) -> Optional[bytes]:
+        _m_gets.inc()
         return self._map.get(key)
 
     async def notify_read(self, key: bytes) -> bytes:

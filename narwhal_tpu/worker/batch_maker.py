@@ -24,15 +24,21 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from typing import List, Optional, Tuple
 
-from .. import native
+from .. import metrics, native
 from ..config import Committee, WorkerId
 from ..crypto import PublicKey, digest32
 from ..network import ReliableSender
 from ..network.framing import parse_address
 
 log = logging.getLogger("narwhal.worker")
+
+# How often the ingress-overflow warning may fire: the event itself is
+# per-batch and a flooded committee would emit thousands of identical
+# lines (and the bench parser reads every one).
+_OVERFLOW_WARN_INTERVAL = 5.0
 
 
 class _TxProtocol(asyncio.Protocol):
@@ -57,6 +63,7 @@ class _TxProtocol(asyncio.Protocol):
         try:
             self.maker._on_tx_data(self.framer, data)
         except ValueError as e:
+            self.maker._m_malformed.inc()
             log.warning("Dropping tx connection (malformed stream): %s", e)
             self.transport.close()
 
@@ -101,6 +108,16 @@ class BatchMaker:
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self.started = asyncio.Event()  # set once the tx socket is bound
         self.boot_error: Optional[BaseException] = None  # bind failure
+        self._m_sealed = metrics.counter("worker.batches_sealed")
+        self._m_tx_bytes = metrics.counter("worker.batch_bytes_sealed")
+        self._m_txs = metrics.counter("worker.txs_sealed")
+        self._m_overflow = metrics.counter("worker.ingress_overflow")
+        self._m_malformed = metrics.counter("worker.malformed_tx_streams")
+        self._trace = metrics.trace()
+        self._last_overflow_warn = 0.0
+        # Plain int alongside the counter: the warning text must report a
+        # true event count even under NARWHAL_METRICS=0 (null counter).
+        self._overflow_events = 0
 
     @property
     def port(self) -> int:
@@ -172,6 +189,13 @@ class BatchMaker:
         # re-hashes in the processor, processor.rs:35 — at ~500 kB per batch
         # the duplicate hash is worth eliminating on shared-core hosts).
         digest = digest32(sealed.message)
+        self._m_sealed.inc()
+        self._m_tx_bytes.inc(sealed.tx_bytes)
+        self._m_txs.inc(sealed.tx_count)
+        self._trace.mark(
+            bytes(digest).hex(), "seal", bytes=sealed.tx_bytes,
+            txs=sealed.tx_count,
+        )
         if self.benchmark:
             # Sample transactions carry byte0 == 0 and a u64 counter; the
             # log parser joins these lines with the client's send log to
@@ -191,7 +215,21 @@ class BatchMaker:
             self.out_queue.put_nowait(item)
         except asyncio.QueueFull:
             # Downstream is lagging: park the batch, stop reading clients
-            # (TCP flow control), drain asynchronously.
+            # (TCP flow control), drain asynchronously.  Counted + a
+            # rate-limited warning: a flooded committee must be VISIBLE
+            # (round 5 published 3 s latencies because this path was
+            # silent, VERDICT.md §1), but one line per parked batch would
+            # melt the log under exactly the load that triggers it.
+            self._m_overflow.inc()
+            self._overflow_events += 1
+            now = time.monotonic()
+            if now - self._last_overflow_warn >= _OVERFLOW_WARN_INTERVAL:
+                self._last_overflow_warn = now
+                log.warning(
+                    "Client ingress overflowing: quorum pipeline full "
+                    "(%d events so far); pausing client sockets",
+                    self._overflow_events,
+                )
             self._overflow.append(item)
             if not self._paused:
                 self._paused = True

@@ -12,6 +12,7 @@ import asyncio
 import logging
 import os
 
+from .. import metrics
 from ..config import Committee
 from ..crypto import PublicKey
 
@@ -31,11 +32,21 @@ class QuorumWaiter:
         self.committee = committee
         self.in_queue = in_queue
         self.out_queue = out_queue
+        self._m_latency = metrics.histogram("worker.quorum_latency_seconds")
+        self._m_reached = metrics.counter("worker.quorum_reached")
+        self._m_dropped = metrics.counter("worker.quorum_dropped")
+        self._mtrace = metrics.trace()
 
     async def run(self) -> None:
         threshold = self.committee.quorum_threshold()
+        loop = asyncio.get_running_loop()
         while True:
             digest, serialized, handlers = await self.in_queue.get()
+            # ACK-latency clock starts here, when the wait begins: the
+            # broadcast itself was enqueued at seal time, so this measures
+            # wire + peer validation + ACK return (minus queue time in
+            # to_quorum, which the queue-depth gauge exposes separately).
+            t0 = loop.time()
             total = self.committee.stake(self.name)  # our own stake counts
             pending = {fut: stake for stake, fut in handlers}
             while total < threshold and pending:
@@ -50,8 +61,12 @@ class QuorumWaiter:
             for fut in pending:
                 fut.cancel()
             if total >= threshold:
+                self._m_latency.observe(loop.time() - t0)
+                self._m_reached.inc()
+                self._mtrace.mark(bytes(digest).hex(), "quorum")
                 if _TRACE:
                     log.info("TRACE quorum reached (%d B)", len(serialized))
                 await self.out_queue.put((digest, serialized))
             else:
+                self._m_dropped.inc()
                 log.warning("Batch dropped: quorum unreachable (got %d)", total)

@@ -14,7 +14,7 @@ import asyncio
 import logging
 from typing import List
 
-from .. import native
+from .. import metrics, native
 from ..config import Committee, Parameters, WorkerId
 from ..crypto import PublicKey
 from ..messages import (
@@ -55,6 +55,9 @@ class WorkerReceiverHandler:
     ) -> None:
         self.others_queue = others_queue
         self.helper_queue = helper_queue
+        self._m_batches_in = metrics.counter("worker.batches_received")
+        self._m_batch_bytes_in = metrics.counter("worker.batch_bytes_received")
+        self._m_malformed = metrics.counter("worker.malformed_frames")
 
     async def dispatch(self, writer: Writer, message: bytes) -> None:
         # Batches are large and their raw frame is the hashing/storage unit:
@@ -64,14 +67,18 @@ class WorkerReceiverHandler:
         # deserialization failure path (worker.rs:264-292).
         if message and message[0] == WORKER_BATCH:
             if native.validate_batch(message) < 0:
+                self._m_malformed.inc()
                 log.warning("Dropping malformed batch frame")
                 return
             await writer.send(b"Ack")
+            self._m_batches_in.inc()
+            self._m_batch_bytes_in.inc(len(message))
             await self.others_queue.put(message)
             return
         try:
             decoded = decode_worker_message(message)
         except ValueError as e:
+            self._m_malformed.inc()
             log.warning("Dropping malformed worker message: %s", e)
             return
         await writer.send(b"Ack")
@@ -134,6 +141,19 @@ class Worker:
         to_primary = q()
         helper_queue = q()
         sync_queue = q()
+
+        # Queue-depth gauges: callbacks polled only at snapshot/scrape
+        # time, so the hot path pays nothing.  These are exactly the
+        # depths the NARWHAL_TRACE heartbeat used to log — now first-class.
+        for gname, gq in (
+            ("worker.queue.to_quorum", to_quorum),
+            ("worker.queue.own_batches", own_batches),
+            ("worker.queue.others_batches", others_batches),
+            ("worker.queue.to_primary", to_primary),
+            ("worker.queue.helper", helper_queue),
+            ("worker.queue.sync", sync_queue),
+        ):
+            metrics.gauge_fn(gname, gq.qsize)
 
         addrs = committee.worker(name, worker_id)
         primary_addr = committee.primary(name).worker_to_primary

@@ -119,6 +119,80 @@ def test_config_echo_mismatch_is_flagged():
     assert any("config echo differs" in e for e in r.errors)
 
 
+def test_errors_name_offending_file_and_line():
+    """Parse errors must locate the bad source (satellite: a mis-scrape
+    used to cost a full re-run to even find the file)."""
+    clients, workers, primaries = make_logs()
+    primaries[0] += "\n" + _t(3000) + " ERROR narwhal.primary boom happened"
+    r = parse_logs(
+        clients,
+        workers,
+        primaries,
+        TX,
+        client_names=["client-0.log"],
+        worker_names=["worker-0.log"],
+        primary_names=["primary-0.log"],
+    )
+    assert any(
+        e.startswith("primary-0.log:") and "boom happened" in e
+        for e in r.errors
+    )
+
+
+def test_config_echo_errors_name_the_file():
+    clients, workers, primaries = make_logs()
+    primaries[0] = primaries[0].replace(
+        " INFO narwhal.node Batch size set to 500000 B\n", "\n"
+    )
+    r = parse_logs(
+        clients, workers, primaries, TX, primary_names=["primary-7.log"]
+    )
+    assert any(
+        "config echo missing" in e
+        and "primary-7.log" in e
+        and "batch_size" in e
+        for e in r.errors
+    )
+
+    # Mismatch names the disagreeing file too.
+    clients, workers, primaries = make_logs()
+    second = primaries[0].replace(
+        "Batch size set to 500000 B", "Batch size set to 9 B"
+    )
+    r = parse_logs(
+        clients,
+        workers,
+        primaries + [second],
+        TX,
+        primary_names=["primary-0.log", "primary-1.log"],
+    )
+    assert any(
+        "config echo differs" in e and "primary-1.log" in e for e in r.errors
+    )
+
+
+def test_committed_without_created_names_digest_and_source():
+    clients, workers, primaries = make_logs()
+    primaries[0] = primaries[0].replace(
+        _t(1700) + " INFO narwhal.primary Created B2(H2=) -> BBB=\n", ""
+    )
+    r = parse_logs(
+        clients, workers, primaries, TX, primary_names=["primary-3.log"]
+    )
+    assert any(
+        "no Created line" in e and "BBB=" in e and "primary-3.log" in e
+        for e in r.errors
+    )
+
+
+def test_unnamed_logs_get_index_labels():
+    """Backwards-compatible call (no names): sources label by index."""
+    clients, workers, primaries = make_logs()
+    primaries[0] += "\n" + _t(3000) + " CRITICAL narwhal.primary dead"
+    r = parse_logs(clients, workers, primaries, TX)
+    assert any(e.startswith("primary[0]:") for e in r.errors)
+
+
 def test_earliest_timestamp_wins_across_primaries():
     clients, workers, primaries = make_logs()
     # A second primary saw the commit of AAA= later; earliest must win.

@@ -1,0 +1,284 @@
+"""Unit tests for the metrics registry (narwhal_tpu/metrics.py): instrument
+semantics, the bounded stage-trace table, snapshot atomicity under a
+concurrent writer, concurrent counter updates from asyncio tasks, the
+Prometheus rendering and HTTP endpoint, and the NARWHAL_METRICS=0 stub."""
+
+import asyncio
+import json
+import math
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from narwhal_tpu import metrics  # noqa: E402
+from narwhal_tpu.metrics import (  # noqa: E402
+    COUNT_BUCKETS,
+    MetricsServer,
+    Registry,
+    SnapshotWriter,
+    TraceTable,
+)
+
+
+def test_counter_gauge_semantics():
+    reg = Registry()
+    c = reg.counter("t.counter")
+    c.inc()
+    c.inc(41)
+    assert c.value == 42
+    assert reg.counter("t.counter") is c  # memoized by name
+
+    g = reg.gauge("t.gauge")
+    g.set(7)
+    g.inc(3)
+    g.dec()
+    assert g.value == 9
+
+    reg.gauge_fn("t.cb", lambda: 123)
+    snap = reg.snapshot()
+    assert snap["counters"]["t.counter"] == 42
+    assert snap["gauges"]["t.gauge"] == 9
+    assert snap["gauges"]["t.cb"] == 123
+
+
+def test_histogram_buckets_and_mean():
+    reg = Registry()
+    h = reg.histogram("t.lat")  # default latency buckets
+    for v in (0.0005, 0.003, 0.003, 0.08, 99.0):
+        h.observe(v)
+    assert h.count == 5
+    assert abs(h.sum - 99.0865) < 1e-9
+    cum = dict(h.cumulative())
+    assert cum[0.001] == 1          # 0.0005
+    assert cum[0.005] == 3          # + both 0.003
+    assert cum[0.1] == 4            # + 0.08
+    assert cum[float("inf")] == 5   # 99.0 lands in +Inf
+    assert abs(h.mean - 99.0865 / 5) < 1e-9
+
+    hc = reg.histogram("t.size", COUNT_BUCKETS)
+    hc.observe(1)
+    hc.observe(1024)
+    hc.observe(5000)
+    assert dict(hc.cumulative())[1] == 1
+    assert dict(hc.cumulative())[float("inf")] == 3
+
+
+def test_trace_table_first_mark_wins_and_bounded():
+    t = TraceTable(cap=3)
+    t.mark("d1", "seal", ts=10.0, bytes=100)
+    t.mark("d1", "seal", ts=5.0)  # later mark must NOT overwrite
+    t.mark("d1", "quorum", ts=11.0)
+    assert t.entries["d1"]["seal"] == 10.0
+    assert t.entries["d1"]["quorum"] == 11.0
+    assert t.entries["d1"]["bytes"] == 100
+    # FIFO eviction at capacity.
+    t.mark("d2", "seal", ts=1.0)
+    t.mark("d3", "seal", ts=1.0)
+    t.mark("d4", "seal", ts=1.0)
+    assert "d1" not in t.entries and len(t.entries) == 3
+    with pytest.raises(ValueError):
+        t.mark("d5", "not_a_stage")
+
+
+def test_concurrent_updates_from_tasks():
+    """1000 increments from 10 interleaved tasks must not lose a count
+    (the single-event-loop execution model the registry assumes)."""
+    reg = Registry()
+    c = reg.counter("t.n")
+    h = reg.histogram("t.h")
+
+    async def worker():
+        for _ in range(100):
+            c.inc()
+            h.observe(0.01)
+            await asyncio.sleep(0)
+
+    async def go():
+        await asyncio.gather(*(worker() for _ in range(10)))
+
+    asyncio.run(go())
+    assert c.value == 1000
+    assert h.count == 1000
+
+
+def test_snapshot_writer_atomic(tmp_path):
+    """Readers polling mid-run must always see valid JSON: the writer
+    rewrites via temp + os.replace, and a final snapshot lands on cancel."""
+    reg = Registry()
+    c = reg.counter("t.n")
+    path = str(tmp_path / "metrics-test.json")
+
+    async def go():
+        writer = SnapshotWriter(reg, path, interval_s=0.005)
+        task = asyncio.get_running_loop().create_task(writer.run())
+        deadline = asyncio.get_running_loop().time() + 0.3
+        reads = 0
+        while asyncio.get_running_loop().time() < deadline:
+            c.inc()
+            if os.path.exists(path):
+                with open(path) as f:
+                    snap = json.load(f)  # must never be torn
+                assert snap["counters"]["t.n"] <= c.value
+                reads += 1
+            await asyncio.sleep(0.002)
+        task.cancel()
+        await asyncio.gather(task, return_exceptions=True)
+        return reads
+
+    reads = asyncio.run(go())
+    assert reads > 10
+    # Final flush on cancellation captured the last value.
+    with open(path) as f:
+        assert json.load(f)["counters"]["t.n"] > 0
+
+
+def test_prometheus_rendering():
+    reg = Registry()
+    reg.counter("worker.batches_sealed").inc(3)
+    reg.gauge("primary.round").set(17)
+    h = reg.histogram("worker.quorum_latency_seconds")
+    h.observe(0.004)
+    text = reg.render_prometheus()
+    assert "# TYPE narwhal_worker_batches_sealed_total counter" in text
+    assert "narwhal_worker_batches_sealed_total 3" in text
+    assert "narwhal_primary_round 17" in text
+    assert 'narwhal_worker_quorum_latency_seconds_bucket{le="+Inf"} 1' in text
+    assert "narwhal_worker_quorum_latency_seconds_count 1" in text
+
+
+def test_metrics_http_endpoint():
+    """GET /metrics serves Prometheus text, /metrics.json the snapshot,
+    anything else 404 — over a raw socket, no http client dependency."""
+    reg = Registry()
+    reg.counter("t.hits").inc(5)
+
+    async def fetch(port, target):
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        writer.write(
+            f"GET {target} HTTP/1.1\r\nHost: x\r\n\r\n".encode()
+        )
+        await writer.drain()
+        data = await reader.read()
+        writer.close()
+        return data
+
+    async def go():
+        server = await MetricsServer.spawn(reg, 0, host="127.0.0.1")
+        try:
+            prom = await fetch(server.port, "/metrics")
+            assert b"200 OK" in prom
+            assert b"narwhal_t_hits_total 5" in prom
+            js = await fetch(server.port, "/metrics.json")
+            body = js.split(b"\r\n\r\n", 1)[1]
+            assert json.loads(body)["counters"]["t.hits"] == 5
+            missing = await fetch(server.port, "/nope")
+            assert b"404" in missing
+        finally:
+            await server.shutdown()
+
+    asyncio.run(go())
+
+
+def test_disabled_registry_is_inert():
+    """NARWHAL_METRICS=0 semantics: every instrument is a shared no-op and
+    snapshots stay empty — the stub the overhead measurement compares
+    against."""
+    reg = Registry(enabled=False)
+    c = reg.counter("t.n")
+    c.inc(100)
+    reg.gauge("t.g").set(5)
+    reg.histogram("t.h").observe(1.0)
+    reg.trace.mark("d", "seal")
+    reg.gauge_fn("t.cb", lambda: 1)
+    snap = reg.snapshot()
+    assert snap["enabled"] is False
+    assert snap["counters"] == {}
+    assert snap["gauges"] == {}
+    assert snap["histograms"] == {}
+    assert snap["trace"] == {}
+
+
+def test_gauge_callback_failure_is_inband():
+    """A dead callback (e.g. a torn-down queue) must not kill the
+    snapshot — it is reported under `errors` instead."""
+    reg = Registry()
+
+    def boom():
+        raise RuntimeError("gone")
+
+    reg.gauge_fn("t.dead", boom)
+    reg.counter("t.ok").inc()
+    snap = reg.snapshot()
+    assert snap["counters"]["t.ok"] == 1
+    assert snap["gauges"]["t.dead"] is None
+    assert any("t.dead" in e for e in snap.get("errors", []))
+    # Prometheus rendering simply skips the dead gauge.
+    assert "t_dead" not in reg.render_prometheus()
+
+
+def test_registry_reset_zeroes_in_place():
+    """reset() must keep instrument IDENTITY (module-level code holds
+    references fetched at import) while zeroing values."""
+    reg = Registry()
+    c = reg.counter("t.n")
+    c.inc(5)
+    h = reg.histogram("t.h")
+    h.observe(1.0)
+    reg.trace.mark("d", "seal")
+    reg.reset()
+    assert reg.counter("t.n") is c and c.value == 0
+    assert h.count == 0 and h.sum == 0.0
+    assert reg.snapshot()["trace"] == {}
+    c.inc()  # the held reference still counts into the registry
+    assert reg.snapshot()["counters"]["t.n"] == 1
+
+
+def test_stage_names_match_metrics_check():
+    """The bench-side join (benchmark/metrics_check.py) and the registry
+    must agree on stage names, or the breakdown silently comes out empty."""
+    from benchmark.metrics_check import STAGE_ORDER
+
+    assert tuple(STAGE_ORDER) == metrics.STAGES
+
+
+def test_cross_validate_agreement_and_failure():
+    """The bench cross-check passes on agreeing channels, hard-fails
+    (error entry) past the 5% tolerance, and emits the stage breakdown."""
+    from benchmark.logs import ParseResult
+    from benchmark.metrics_check import cross_validate
+
+    def snap(trace):
+        return {"enabled": True, "trace": trace}
+
+    # Worker snapshot: seal/quorum stamps + bytes; primary snapshot: the
+    # rest of the chain.  Two batches of 512 B * 100 tx each.
+    worker = snap({
+        "d1": {"seal": 1.0, "quorum": 1.1, "bytes": 51200},
+        "d2": {"seal": 2.0, "quorum": 2.1, "bytes": 51200},
+    })
+    primary = snap({
+        "d1": {"digest_at_primary": 1.2, "header": 1.3, "cert": 1.5,
+               "commit": 1.9},
+        "d2": {"digest_at_primary": 2.2, "header": 2.3, "cert": 2.5,
+               "commit": 2.9},
+    })
+
+    r = ParseResult(committed_bytes=102400)
+    summary = cross_validate(r, [worker, primary], tx_size=512)
+    assert not r.errors
+    assert r.metrics_committed_tx == 200.0
+    assert r.metrics_disagreement == 0.0
+    assert summary["traced_full_chain"] == 2
+    # Mean per-leg latencies (both batches identical): e.g. seal→quorum
+    # 100 ms, cert→commit 400 ms, full chain 900 ms.
+    assert math.isclose(r.stages_ms["seal_to_quorum"], 100.0, abs_tol=0.2)
+    assert math.isclose(r.stages_ms["cert_to_commit"], 400.0, abs_tol=0.2)
+    assert math.isclose(r.stages_ms["seal_to_commit"], 900.0, abs_tol=0.2)
+
+    # >5% disagreement between channels is fatal.
+    r2 = ParseResult(committed_bytes=200000)
+    cross_validate(r2, [worker, primary], tx_size=512)
+    assert any("cross-check FAILED" in e for e in r2.errors)

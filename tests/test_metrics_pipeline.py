@@ -1,0 +1,152 @@
+"""Tier-1 in-process pipeline metrics test: a 4-node committee (primary +
+worker + consensus each) on loopback TCP, client transactions pushed into
+node 0, and the per-process metrics registry must tell a CONSISTENT story
+end to end:
+
+- conservation: every batch sealed is either committed or accounted for by
+  a drop counter (batches sealed == committed + quorum-dropped);
+- the stage trace carries all six pipeline stamps per committed digest, in
+  monotonic (causal) order: seal ≤ quorum ≤ digest-at-primary ≤ header ≤
+  cert ≤ commit;
+- layer counters (headers proposed, votes, certificates, commits, store
+  puts, network frames) are live and mutually consistent.
+
+This is the standalone target of `make metrics-smoke`; when
+NARWHAL_METRICS_DUMP is set (CI), the final registry snapshot is written
+there as an inspectable workflow artifact.
+"""
+
+import asyncio
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from narwhal_tpu import metrics
+from narwhal_tpu.config import Parameters
+from narwhal_tpu.crypto import digest32
+from narwhal_tpu.messages import encode_batch
+from narwhal_tpu.network.framing import parse_address, write_frame
+from narwhal_tpu.node import spawn_primary_node, spawn_worker_node
+from tests.common import committee, keys
+
+
+def test_pipeline_metrics_consistency():
+    reg = metrics.registry()
+    reg.reset()
+
+    async def go():
+        c = committee(base_port=15400)
+        params = Parameters(
+            header_size=32,  # propose as soon as one digest arrives
+            max_header_delay=100,
+            batch_size=400,
+            max_batch_delay=100,
+        )
+        commits = {i: [] for i in range(4)}
+        nodes = []
+        for i, kp in enumerate(keys()):
+            nodes.append(
+                await spawn_primary_node(
+                    kp,
+                    c,
+                    params,
+                    on_commit=lambda cert, i=i: commits[i].append(cert),
+                )
+            )
+            nodes.append(await spawn_worker_node(kp, 0, c, params))
+
+        # Push 8 txs into node 0's worker; batch_size=400 seals every 4 of
+        # the 100 B txs into one batch (same shape as test_e2e).
+        host, port = parse_address(c.worker(keys()[0].name, 0).transactions)
+        _, w = await asyncio.open_connection(host, port)
+        txs = [
+            bytes([1]) + (0xA500 + i).to_bytes(8, "little") + bytes(91)
+            for i in range(8)
+        ]
+        for tx in txs:
+            await write_frame(w, tx)
+
+        expected = {
+            digest32(encode_batch(txs[:4])),
+            digest32(encode_batch(txs[4:])),
+        }
+        expected_hex = {bytes(d).hex() for d in expected}
+
+        def payload_committed(certs):
+            return expected <= {
+                d for cert in certs for d in cert.header.payload
+            }
+
+        for _ in range(600):
+            if all(payload_committed(v) for v in commits.values()):
+                break
+            await asyncio.sleep(0.1)
+        else:
+            raise AssertionError(
+                f"payload never committed: {[len(v) for v in commits.values()]}"
+            )
+
+        w.close()
+        for node in nodes:
+            await node.shutdown()
+        return expected_hex
+
+    expected_hex = asyncio.run(asyncio.wait_for(go(), 60))
+
+    snap = reg.snapshot()
+    counters = snap["counters"]
+    trace = snap["trace"]
+
+    # --- conservation: sealed == committed + dropped ------------------------
+    # All 4 nodes share this process's registry; only node 0's worker
+    # sealed batches.  Every sealed digest must reach commit (or be
+    # accounted for by the quorum-drop counter — zero in a healthy run).
+    sealed_digests = {d for d, e in trace.items() if "seal" in e}
+    committed_digests = {d for d, e in trace.items() if "commit" in e}
+    dropped = counters.get("worker.quorum_dropped", 0)
+    assert counters["worker.batches_sealed"] == len(sealed_digests)
+    assert len(sealed_digests) == len(sealed_digests & committed_digests) + dropped, (
+        f"sealed {len(sealed_digests)} != committed "
+        f"{len(sealed_digests & committed_digests)} + dropped {dropped}"
+    )
+    assert expected_hex <= sealed_digests
+    assert expected_hex <= committed_digests
+    # 8 txs of 100 B each, split into two sealed batches.
+    assert counters["worker.txs_sealed"] == 8
+    assert counters["worker.batch_bytes_sealed"] == 800
+
+    # --- stage stamps present and monotonic ---------------------------------
+    order = list(metrics.STAGES)
+    for d in expected_hex:
+        entry = trace[d]
+        stamps = [entry[s] for s in order if s in entry]
+        assert len(stamps) == len(order), (
+            f"digest {d} missing stages: {sorted(set(order) - set(entry))}"
+        )
+        assert stamps == sorted(stamps), (
+            f"stage timestamps not monotonic for {d}: "
+            f"{[(s, entry[s]) for s in order]}"
+        )
+
+    # --- layer counters live and consistent ---------------------------------
+    assert counters["worker.quorum_reached"] >= len(expected_hex)
+    assert counters["primary.headers_proposed"] > 0
+    assert counters["primary.votes_received"] > 0
+    assert counters["primary.certificates_formed"] > 0
+    assert counters["consensus.committed_certificates"] > 0
+    # Each of the 4 consensus instances committed both payload batches.
+    assert counters["consensus.committed_batch_digests"] >= 2 * 4
+    assert counters["store.puts"] > 0
+    assert counters["net.reliable.frames_sent"] > 0
+    assert counters["net.recv.frames"] > 0
+    hist = snap["histograms"]["worker.quorum_latency_seconds"]
+    assert hist["count"] == counters["worker.quorum_reached"]
+
+    # --- CI artifact dump ----------------------------------------------------
+    dump_dir = os.environ.get("NARWHAL_METRICS_DUMP")
+    if dump_dir:
+        os.makedirs(dump_dir, exist_ok=True)
+        with open(os.path.join(dump_dir, "metrics-smoke.json"), "w") as f:
+            json.dump(snap, f, indent=1)
