@@ -37,12 +37,15 @@ test-ci:
 		--continue-on-collection-errors
 
 # Standalone in-process pipeline metrics test (4-node committee in one
-# process; asserts sealed==committed+dropped and monotonic stage stamps).
+# process; asserts sealed==committed+dropped and monotonic stage stamps),
+# then a live-node /healthz probe: boots a real `node run` process with
+# --metrics-port and fails on anything but 200 with zero firing rules.
 # Dumps the final registry snapshot to .ci-artifacts/metrics-smoke.json,
 # which CI uploads as a workflow artifact.
 metrics-smoke: native
 	JAX_PLATFORMS=cpu NARWHAL_METRICS_DUMP=.ci-artifacts \
 		$(PYTHON) -m pytest tests/test_metrics_pipeline.py -x -q
+	JAX_PLATFORMS=cpu $(PYTHON) benchmark/health_smoke.py
 
 # The crypto differential suite under the float32 lane dtype (the default
 # run covers int32 + a narrow f32 subprocess check; run this after any

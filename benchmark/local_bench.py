@@ -32,7 +32,13 @@ from narwhal_tpu.config import (  # noqa: E402
 )
 from narwhal_tpu.crypto import KeyPair  # noqa: E402
 from benchmark.logs import parse_logs  # noqa: E402
-from benchmark.metrics_check import cross_validate, load_snapshots  # noqa: E402
+from benchmark.metrics_check import (  # noqa: E402
+    build_timeline,
+    check_quiesce_health,
+    cross_validate,
+    load_snapshots,
+)
+from benchmark.scraper import Scraper  # noqa: E402
 
 
 def build_committee(keypairs, base_port, workers, ips=None, worker_ips=None):
@@ -123,6 +129,8 @@ def run_bench(
     crypto_backend: str = None,
     consensus_kernel: bool = False,
     tpu_primaries: int = None,
+    scrape_interval: float = 1.0,
+    progress_wait: float = 0.0,
 ):
     """Run one committee + clients on localhost; return the ParseResult.
 
@@ -131,6 +139,13 @@ def run_bench(
     chip, so a mixed committee (one device-backed primary, the rest CPU)
     is the honest way to exercise the device path end-to-end.  ``None``
     means every primary gets the flags (all-CPU or all-TPU runs).
+
+    ``progress_wait``: extra seconds (beyond ``duration``) the window may
+    stretch while the scraped metrics show zero committed PAYLOAD batches
+    — on a starved shared core the clients can ramp so late that the
+    fixed window closes before the first client batch commits (empty
+    headers commit throughout, so certificate counts can't gate this).
+    0 keeps the fixed-duration behavior; requires metrics enabled.
     """
     kill_stale_nodes()
     workdir = workdir or os.path.join(REPO, ".bench")
@@ -178,6 +193,12 @@ def run_bench(
     # overhead measurement flips; cross-validation is skipped since the
     # snapshots would be empty.
     metrics_on = os.environ.get("NARWHAL_METRICS", "1") != "0"
+    # Live scrape plane: every node also gets a --metrics-port in the
+    # block directly after the committee's own ports, and the harness
+    # polls them all during the run (benchmark/scraper.py) to build the
+    # committee timeline and gate on /healthz at quiesce.
+    metrics_port_base = base_port + nodes * (2 + 3 * workers)
+    scrape_targets = []  # (name, host, port)
 
     def spawn(cmd, logfile, env=cpu_env, tpu=False):
         f = open(logfile, "w")
@@ -242,6 +263,8 @@ def run_bench(
         primary_logs.append(log)
         mpath = f"{workdir}/metrics-primary-{i}.json"
         metrics_paths.append(mpath)
+        mport = metrics_port_base + i
+        scrape_targets.append((f"primary-{i}", "127.0.0.1", mport))
         spawn(
             [
                 sys.executable,
@@ -259,6 +282,8 @@ def run_bench(
                 "--benchmark",
                 "--metrics-path",
                 mpath,
+                "--metrics-port",
+                str(mport),
                 *base_flags,
                 *(device_flags if on_tpu else []),
                 "primary",
@@ -272,6 +297,8 @@ def run_bench(
             worker_logs.append(log)
             mpath = f"{workdir}/metrics-worker-{i}-{wid}.json"
             metrics_paths.append(mpath)
+            mport = metrics_port_base + nodes + i * workers + wid
+            scrape_targets.append((f"worker-{i}-{wid}", "127.0.0.1", mport))
             spawn(
                 [
                     sys.executable,
@@ -289,6 +316,8 @@ def run_bench(
                     "--benchmark",
                     "--metrics-path",
                     mpath,
+                    "--metrics-port",
+                    str(mport),
                     "worker",
                     "--id",
                     str(wid),
@@ -347,7 +376,21 @@ def run_bench(
 
     if not quiet:
         print(f"Running benchmark ({duration} s)...", file=sys.stderr)
+    # The scraper runs across the whole measurement window, building the
+    # committee time-series the post-mortem snapshots cannot: per-node
+    # progress at each tick, so mid-run stalls have a timestamp.
+    scraper = None
+    healthz = {}
+    if metrics_on:
+        scraper = Scraper(scrape_targets, interval_s=scrape_interval).start()
     time.sleep(duration)
+    if scraper is not None:
+        scraper.wait_for_payload_commits(progress_wait, quiet=quiet)
+    if scraper is not None:
+        # Quiesce gate BEFORE teardown: a firing health rule on any live
+        # node fails the run (appended to result.errors below).
+        healthz = scraper.healthz_all()
+        scraper.stop()
 
     # SIGTERM first (lets NARWHAL_PROFILE dumps flush), then SIGKILL.
     # Chip-holding children get a much longer grace period: SIGKILLing a
@@ -396,6 +439,14 @@ def run_bench(
     if metrics_on:
         snapshots = load_snapshots(metrics_paths, result.errors)
         cross_validate(result, snapshots, tx_size)
+        check_quiesce_health(healthz, result.errors)
+        result.timeline = build_timeline(
+            scraper.samples if scraper else [],
+            interval_s=scrape_interval,
+            healthz=healthz,
+        )
+        with open(f"{workdir}/timeline.json", "w") as f:
+            json.dump(result.timeline, f, indent=1)
     if not keep_logs:
         for i in range(alive):
             shutil.rmtree(f"{storedir}/db-primary-{i}", ignore_errors=True)
@@ -470,6 +521,9 @@ def main():
                         result.metrics_committed_tx, 1
                     ),
                     "metrics_disagreement": result.metrics_disagreement,
+                    # Live committee timeline (scraper): per-node series,
+                    # per-peer RTT matrix, /healthz verdicts at quiesce.
+                    "timeline": result.timeline,
                 }
             )
         )
@@ -485,6 +539,16 @@ def main():
             print(
                 f"   metrics vs log committed-tx disagreement: "
                 f"{100 * result.metrics_disagreement:.2f}%"
+            )
+        if result.timeline.get("nodes"):
+            n_samples = sum(
+                len(v) for v in result.timeline["nodes"].values()
+            )
+            print(
+                f" + TIMELINE: {n_samples} scrape samples across "
+                f"{len(result.timeline['nodes'])} nodes, RTT matrix for "
+                f"{len(result.timeline.get('rtt_ms', {}))} nodes "
+                "(full series in .bench/timeline.json)"
             )
 
 

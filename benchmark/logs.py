@@ -96,6 +96,11 @@ class ParseResult:
     metrics_committed_tx: float = 0.0
     metrics_disagreement: float | None = None
     stages_ms: Dict[str, float] = field(default_factory=dict)
+    # Committee-wide time-series scraped live from every node's
+    # --metrics-port during the run (benchmark/scraper.py →
+    # metrics_check.build_timeline): per-node TPS/round/commit-lag over
+    # time, per-peer RTT matrix, and the /healthz verdicts at quiesce.
+    timeline: Dict = field(default_factory=dict)
 
     def summary(self, rate: int, tx_size: int, nodes: int, workers: int) -> str:
         return (

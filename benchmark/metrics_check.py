@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 from typing import Dict, List, Optional, Tuple
 
 # Causal stage order: the registry's definition IS the source of truth
@@ -64,6 +65,26 @@ def cross_validate(
 
     Returns the summary dict the bench JSON embeds.
     """
+    # Trace-table evictions mean the stage join below is UNDER-JOINED:
+    # evicted digests stamped early in the run are invisible, so the
+    # breakdown is biased toward the run's tail and the metrics-side
+    # committed-bytes total undercounts.  Warn loudly and annotate the
+    # result instead of silently computing a biased answer.
+    evictions = sum(
+        int(snap.get("gauges", {}).get("metrics.trace_evictions") or 0)
+        for snap in snapshots
+        if snap.get("enabled", True)
+    )
+    if evictions > 0:
+        print(
+            "WARNING: stage-trace tables UNDER-JOINED — "
+            f"{evictions} digest(s) evicted past NARWHAL_TRACE_CAP; "
+            "the stages_ms breakdown and metrics committed-tx total are "
+            "biased toward the run's tail (raise NARWHAL_TRACE_CAP or "
+            "shorten the run)",
+            file=sys.stderr,
+        )
+
     # Earliest timestamp per (digest, stage) across every snapshot —
     # the same convention the log parser uses across primaries.
     stage_ts: Dict[str, Dict[str, float]] = {}
@@ -126,13 +147,129 @@ def cross_validate(
         result.stages_ms["seal_to_commit"] = round(
             1000 * sum(totals) / len(totals), 2
         )
+    if evictions > 0:
+        # In-band annotation next to the numbers the evictions bias.
+        result.stages_ms["trace_evictions"] = float(evictions)
 
     return {
         "stages_ms": dict(result.stages_ms),
         "traced_full_chain": len(totals),
+        "trace_evictions": evictions,
         "metrics_committed_tx": round(result.metrics_committed_tx, 1),
         "log_committed_tx": round(log_tx, 1),
         "disagreement": (
             round(disagreement, 4) if disagreement is not None else None
         ),
     }
+
+
+# -- committee-wide timeline from scraped samples -----------------------------
+
+_PEER_RTT_PREFIX = "net.reliable.peer.rtt_seconds."
+
+
+def build_timeline(
+    samples: List[dict],
+    interval_s: float = 1.0,
+    healthz: Optional[Dict[str, tuple]] = None,
+) -> dict:
+    """Turn the scraper's raw sample stream into the timeline section of
+    the bench JSON:
+
+        {"interval_s": s,
+         "nodes": {name: [{"t", "round", "commit_lag", "commits",
+                           "committed_batches", "txs_sealed",
+                           "pending_acks", "health_firing",
+                           "commit_rate_per_s", "txs_sealed_per_s"}, …]},
+         "rtt_ms": {name: {peer_addr: {"mean_ms", "count"}}},
+         "healthz": {name: {"status": code|None, "firing": [rule names]}}}
+
+    Per-sample rates are deltas against the node's previous sample, so a
+    mid-run stall shows as a rate dip AT ITS TIME — the thing the
+    post-mortem snapshot can structurally never show.  The RTT matrix
+    comes from each node's LAST sample (per-peer histograms are
+    cumulative, so last = whole-run mean).
+    """
+    by_node: Dict[str, List[dict]] = {}
+    for s in sorted(samples, key=lambda s: s.get("t", 0.0)):
+        by_node.setdefault(s["node"], []).append(s)
+
+    nodes: Dict[str, List[dict]] = {}
+    rtt_ms: Dict[str, Dict[str, dict]] = {}
+    for name, node_samples in by_node.items():
+        series: List[dict] = []
+        prev: Optional[dict] = None
+        for s in node_samples:
+            counters, gauges = s["counters"], s["gauges"]
+            health = s.get("health") or {}
+            point = {
+                "t": round(s["t"], 3),
+                "round": gauges.get("primary.round"),
+                "commit_lag": gauges.get("consensus.commit_lag_rounds"),
+                "commits": counters.get(
+                    "consensus.committed_certificates"
+                ),
+                "committed_batches": counters.get(
+                    "consensus.committed_batch_digests"
+                ),
+                "txs_sealed": counters.get("worker.txs_sealed"),
+                "pending_acks": gauges.get("net.reliable.pending_acks"),
+                "health_firing": len(health.get("firing", [])),
+            }
+            if prev is not None and s["t"] > prev["t"]:
+                dt = s["t"] - prev["t"]
+                for rate_key, src_key in (
+                    ("commit_rate_per_s", "commits"),
+                    ("txs_sealed_per_s", "txs_sealed"),
+                ):
+                    a, b = prev.get(src_key), point.get(src_key)
+                    if a is not None and b is not None:
+                        point[rate_key] = round((b - a) / dt, 2)
+            series.append(point)
+            prev = point
+        nodes[name] = series
+
+        # Per-peer RTT from the node's last sample's histograms.
+        last = node_samples[-1]
+        peers = {}
+        for hname, h in (last.get("histograms") or {}).items():
+            if hname.startswith(_PEER_RTT_PREFIX) and h.get("count"):
+                peers[hname[len(_PEER_RTT_PREFIX):]] = {
+                    "mean_ms": round(1000 * h["sum"] / h["count"], 3),
+                    "count": h["count"],
+                }
+        if peers:
+            rtt_ms[name] = peers
+
+    out = {"interval_s": interval_s, "nodes": nodes, "rtt_ms": rtt_ms}
+    if healthz is not None:
+        out["healthz"] = {
+            name: {
+                "status": status,
+                "firing": [
+                    f.get("rule")
+                    for f in ((body or {}).get("firing") or [])
+                ],
+            }
+            for name, (status, body) in healthz.items()
+        }
+    return out
+
+
+def check_quiesce_health(
+    healthz: Dict[str, tuple], errors: List[str]
+) -> None:
+    """The harness's live-health gate: any node whose /healthz reports a
+    firing rule at quiesce fails the run (error entry — fatal to every
+    caller).  An unreachable endpoint is NOT a failure here: nodes
+    without --metrics-port (or already torn down) simply aren't gated."""
+    for name, (status, body) in sorted(healthz.items()):
+        if status is not None and status != 200:
+            rules = ", ".join(
+                f"{f.get('rule')}[{f.get('subject')}]"
+                for f in ((body or {}).get("firing") or [])
+            ) or "unknown"
+            errors.append(
+                f"health check FAILED at quiesce: {name} /healthz "
+                f"returned {status} with firing rule(s): {rules}"
+            )

@@ -47,6 +47,11 @@ from narwhal_tpu.config import Parameters, export_keypair  # noqa: E402
 from narwhal_tpu.crypto import KeyPair  # noqa: E402
 from benchmark.local_bench import build_committee  # noqa: E402
 from benchmark.logs import parse_logs  # noqa: E402
+from benchmark.metrics_check import (  # noqa: E402
+    build_timeline,
+    check_quiesce_health,
+)
+from benchmark.scraper import Scraper  # noqa: E402
 
 
 class LocalRunner:
@@ -207,7 +212,20 @@ def run_remote_bench(
     keep_logs: bool = False,
     quiet: bool = False,
     collocate: bool = True,
+    scrape_interval: float = 1.0,
+    progress_wait: float = 0.0,
 ):
+    """Launch the committee across ``hosts`` and measure.
+
+    ``progress_wait``: extra seconds (beyond ``duration``) the
+    measurement window may stretch while the scraped metrics show ZERO
+    committed payload batches committee-wide — a wall-clock progress
+    check replacing blind trust in one fixed sleep (on a loaded shared
+    core the whole boot can eat the window; the reference harness has
+    the same failure mode).  Batch digests, not certificates: empty
+    headers commit on an idle committee too.  0 keeps the reference's
+    fixed-duration behavior.
+    """
     runners = [make_runner(h) for h in hosts]
     # Role→host placement.  Collocated (default): authority i's primary,
     # workers and clients all on host i%H — the reference's default.  Non-
@@ -286,6 +304,14 @@ def run_remote_bench(
             r.put(f"{stage}/node-{i}.json", f"configs/node-{i}.json")
 
     # Launch primaries and workers, then clients (reference remote.py:213-271).
+    # Every node gets a --metrics-port in the block directly after the
+    # committee's own ports (globally sequential, so co-hosted nodes
+    # never collide); the launcher scrapes them across the wire during
+    # the run — the remote harness finally collects live metrics instead
+    # of nothing (ROADMAP item).  The servers bind 0.0.0.0 via the
+    # NARWHAL_BIND_ANY=1 that _spawn_cmd already sets.
+    metrics_port_base = base_port + nodes * (2 + 3 * workers)
+    scrape_targets = []  # (name, host_ip, port)
     primary_logs, worker_logs, client_logs = [], [], []
     for i in range(nodes):
         common = [
@@ -296,18 +322,30 @@ def run_remote_bench(
             "--benchmark",
         ]
         r = p_host(i)
+        mport = metrics_port_base + i
+        scrape_targets.append((f"primary-{i}", r.ip, mport))
         primary_logs.append((r, f"logs/primary-{i}.log"))
         _spawn_cmd(
             r,
-            common + ["--store", f"db-primary-{i}", "primary"],
+            common + [
+                "--store", f"db-primary-{i}",
+                "--metrics-port", str(mport),
+                "primary",
+            ],
             f"logs/primary-{i}.log",
         )
         for w in range(workers):
             rw = w_host(i, w)
+            mport = metrics_port_base + nodes + i * workers + w
+            scrape_targets.append((f"worker-{i}-{w}", rw.ip, mport))
             worker_logs.append((rw, f"logs/worker-{i}-{w}.log"))
             _spawn_cmd(
                 rw,
-                common + ["--store", f"db-worker-{i}-{w}", "worker", "--id", str(w)],
+                common + [
+                    "--store", f"db-worker-{i}-{w}",
+                    "--metrics-port", str(mport),
+                    "worker", "--id", str(w),
+                ],
                 f"logs/worker-{i}-{w}.log",
             )
 
@@ -359,7 +397,15 @@ def run_remote_bench(
 
     if not quiet:
         print(f"Running remote benchmark ({duration} s)...", file=sys.stderr)
+    scraper = Scraper(scrape_targets, interval_s=scrape_interval).start()
     time.sleep(duration)
+    # Wall-clock progress check: only close the window once the scraped
+    # metrics have shown a committed payload batch (or progress_wait
+    # runs out).
+    scraper.wait_for_payload_commits(progress_wait, quiet=quiet)
+    # Quiesce gate BEFORE teardown: any firing health rule fails the run.
+    healthz = scraper.healthz_all()
+    scraper.stop()
 
     for r in runners:
         kill_ours(r, sig="TERM")
@@ -387,6 +433,12 @@ def run_remote_bench(
         fetch(primary_logs, "primary"),
         tx_size,
     )
+    check_quiesce_health(healthz, result.errors)
+    result.timeline = build_timeline(
+        scraper.samples, interval_s=scrape_interval, healthz=healthz
+    )
+    with open(f"{stage}/timeline.json", "w") as f:
+        json.dump(result.timeline, f, indent=1)
     for r in runners:
         r.run("rm -rf db-primary-* db-worker-*", check=False)
         if not keep_logs:
@@ -488,6 +540,7 @@ def main() -> None:
                     "end_to_end_latency_ms": result.end_to_end_latency_ms,
                     "samples": result.samples,
                     "errors": result.errors[:10],
+                    "timeline": result.timeline,
                 }
             )
         )

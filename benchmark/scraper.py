@@ -1,0 +1,212 @@
+"""Committee-wide time-series scraper over the nodes' --metrics-port.
+
+The snapshot files (--metrics-path) are post-mortem: one final state per
+node, great for totals, blind to anything that happens DURING the run —
+a peer that stalls at t=8s and recovers at t=15s leaves an unremarkable
+final snapshot.  This scraper is the live channel: it polls every node's
+``GET /metrics.json?trace=0`` (and ``/healthz``) at a fixed cadence from
+the bench harness, accumulating a committee-wide time-series that
+``benchmark.metrics_check.build_timeline`` turns into the per-node
+TPS/round/commit-lag timeline and per-peer RTT matrix embedded in the
+bench JSON.
+
+Dependency-free by design (urllib over the hand-rolled MetricsServer);
+runs in a daemon thread because both bench harnesses are synchronous
+process-wranglers.  A node that is slow, dead, or not yet up simply
+yields no sample that tick — scraping must never perturb or abort the
+run it is measuring.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional, Tuple
+
+# (logical node name, host, port) — the name keys the timeline.
+Target = Tuple[str, str, int]
+
+
+def fetch_json(
+    host: str, port: int, path: str, timeout_s: float = 2.0
+) -> Tuple[Optional[int], Optional[dict]]:
+    """GET http://host:port/path → (status, parsed body) — (None, None)
+    when unreachable.  5xx bodies are read and parsed too: /healthz
+    carries its rule list in the 503 body."""
+    url = f"http://{host}:{port}{path}"
+    try:
+        with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+            return resp.status, json.loads(resp.read().decode())
+    except urllib.error.HTTPError as e:
+        try:
+            return e.code, json.loads(e.read().decode())
+        except (ValueError, OSError):
+            return e.code, None
+    except (urllib.error.URLError, OSError, ValueError):
+        return None, None
+
+
+class Scraper:
+    """Polls every target's /metrics.json at ``interval_s``, appending
+    one sample dict per (tick, reachable node) to ``samples``:
+
+        {"t": unix_ts, "node": name,
+         "counters": {...}, "gauges": {...},
+         "histograms": {...}, "health": {...} | None}
+
+    ``start()``/``stop()`` bracket the measurement window; ``stop()``
+    joins the thread so the sample list is final when the harness reads
+    it.  ``healthz_all()`` is the quiesce gate: one /healthz round,
+    {name: (status_code | None, body | None)}.
+    """
+
+    def __init__(
+        self,
+        targets: List[Target],
+        interval_s: float = 1.0,
+        timeout_s: float = 2.0,
+    ) -> None:
+        self.targets = list(targets)
+        self.interval_s = interval_s
+        self.timeout_s = timeout_s
+        self.samples: List[dict] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # All targets are polled CONCURRENTLY: sequentially, one hung
+        # node would cost its full timeout per tick and destroy the
+        # fixed cadence for every OTHER node — exactly when the per-node
+        # resolution matters most (a stalled committee).
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(1, min(len(self.targets), 16)),
+            thread_name_prefix="metrics-scrape",
+        )
+
+    def sample_once(self) -> int:
+        """One scrape round (all targets concurrently); returns how many
+        nodes answered."""
+
+        def one(target: Target) -> Optional[dict]:
+            name, host, port = target
+            status, snap = fetch_json(
+                host, port, "/metrics.json?trace=0", self.timeout_s
+            )
+            if status != 200 or not isinstance(snap, dict):
+                return None
+            return {
+                "t": snap.get("ts", time.time()),
+                "node": name,
+                "counters": snap.get("counters", {}),
+                "gauges": snap.get("gauges", {}),
+                "histograms": snap.get("histograms", {}),
+                "health": snap.get("health"),
+            }
+
+        got = 0
+        for sample in self._pool.map(one, self.targets):
+            if sample is not None:
+                self.samples.append(sample)
+                got += 1
+        return got
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            t0 = time.time()
+            try:
+                self.sample_once()
+            except Exception:
+                # A scrape crash must never take the bench down with it.
+                pass
+            # Fixed cadence net of scrape cost, so sample spacing stays
+            # ~interval_s even when a node is slow to answer.
+            remaining = self.interval_s - (time.time() - t0)
+            if remaining > 0:
+                self._stop.wait(remaining)
+
+    def start(self) -> "Scraper":
+        self._thread = threading.Thread(
+            target=self._run, name="metrics-scraper", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        self._pool.shutdown(wait=False)
+
+    def healthz_all(
+        self, retries: int = 2, retry_delay_s: float = 1.0
+    ) -> Dict[str, Tuple[Optional[int], Optional[dict]]]:
+        """One concurrent /healthz round, re-probing only UNREACHABLE
+        targets up to ``retries`` more times: on a starved core a node's
+        event loop can miss one 2 s accept window while perfectly
+        healthy, and a transient None must not read as a verdict."""
+        out: Dict[str, Tuple[Optional[int], Optional[dict]]] = {}
+        remaining = list(self.targets)
+        for attempt in range(1 + max(0, retries)):
+            if not remaining:
+                break
+            if attempt:
+                time.sleep(retry_delay_s)
+            verdicts = self._pool.map(
+                lambda t: fetch_json(t[1], t[2], "/healthz", self.timeout_s),
+                remaining,
+            )
+            retry = []
+            for target, verdict in zip(remaining, verdicts):
+                out[target[0]] = verdict
+                if verdict[0] is None:
+                    retry.append(target)
+            remaining = retry
+        return out
+
+    def _max_counter(self, name: str) -> int:
+        return int(
+            max(
+                (s["counters"].get(name, 0) for s in self.samples),
+                default=0,
+            )
+        )
+
+    def commits_observed(self) -> int:
+        """Max committed-certificate count seen on any node so far."""
+        return self._max_counter("consensus.committed_certificates")
+
+    def payload_commits_observed(self) -> int:
+        """Max committed-BATCH count seen on any node — the wall-clock
+        progress signal the harnesses use to widen a measurement window
+        instead of trusting one fixed sleep.  Batch digests, not
+        certificates: an idle committee commits empty headers, so the
+        certificate counter rises while zero client payload has landed
+        (observed on a starved shared core: 32 committed certs, 0
+        committed batches at window close)."""
+        return self._max_counter("consensus.committed_batch_digests")
+
+    def wait_for_payload_commits(
+        self, extra_s: float, quiet: bool = True
+    ) -> bool:
+        """Stretch a measurement window by up to ``extra_s`` while the
+        committee shows ZERO committed payload batches (the shared
+        progress-check used by both bench harnesses); returns whether
+        payload progress was ultimately observed."""
+        if extra_s <= 0 or self.payload_commits_observed() > 0:
+            return self.payload_commits_observed() > 0
+        if not quiet:
+            print(
+                "no payload commits observed yet; extending measurement "
+                f"window (up to {extra_s:.0f} s)",
+                file=sys.stderr,
+            )
+        deadline = time.time() + extra_s
+        while (
+            self.payload_commits_observed() == 0 and time.time() < deadline
+        ):
+            time.sleep(min(2.0, max(0.5, self.interval_s)))
+        return self.payload_commits_observed() > 0

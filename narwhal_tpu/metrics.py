@@ -18,7 +18,14 @@ primary, consensus, store) writes into, plus
   threads a sample-transaction trace through the whole pipeline
   (batch-sealed → quorum → digest-at-primary → header → certificate →
   committed), the per-stage latency breakdown the Narwhal paper uses to
-  argue the digest-only critical path.
+  argue the digest-only critical path;
+- :class:`HealthMonitor` — a declarative anomaly-rules engine evaluated
+  on a timer over registry values (absolute ceilings, rate-of-change
+  windows, per-peer thresholds) with hysteresis, feeding structured
+  anomaly events to the log, a ``health`` section in snapshots, and the
+  ``/healthz`` route (200/503) on the :class:`MetricsServer` — live
+  detection of the wedges (stalled peer, quorum-waiter at 2f, backoff
+  storm) that post-mortem snapshot archaeology only finds after the run.
 
 Hot-path cost model: a counter ``inc`` is one attribute add, a histogram
 ``observe`` is one ``bisect`` + two adds; queue depths and sender backlogs
@@ -34,12 +41,22 @@ Everything here assumes the single-event-loop execution model of the node
 from __future__ import annotations
 
 import asyncio
+import collections
 import json
 import logging
 import os
 import time
 from bisect import bisect_left
-from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from typing import (
+    Callable,
+    Deque,
+    Dict,
+    List,
+    Mapping,
+    Optional,
+    Sequence,
+    Tuple,
+)
 
 log = logging.getLogger("narwhal.metrics")
 
@@ -149,11 +166,16 @@ class TraceTable:
     clocks cannot do.
     """
 
-    __slots__ = ("cap", "entries")
+    __slots__ = ("cap", "entries", "evictions")
 
     def __init__(self, cap: int = 32_768) -> None:
         self.cap = cap
         self.entries: Dict[str, Dict[str, float]] = {}
+        # Evictions past the cap: each one is a digest the bench-side
+        # stage join will silently miss, so the count is exported (see
+        # Registry.__init__) and the harness warns loudly when > 0
+        # instead of computing a biased breakdown (ROADMAP item).
+        self.evictions = 0
 
     def mark(
         self, digest_hex: str, stage: str, ts: Optional[float] = None, **extra
@@ -165,6 +187,7 @@ class TraceTable:
             if len(self.entries) >= self.cap:
                 # FIFO eviction: dicts iterate in insertion order.
                 self.entries.pop(next(iter(self.entries)))
+                self.evictions += 1
             entry = self.entries[digest_hex] = {}
         entry.setdefault(stage, ts if ts is not None else time.time())
         for k, v in extra.items():
@@ -186,6 +209,7 @@ class _Null:
     counts: List[int] = []
     cap = 0
     entries: Dict[str, Dict[str, float]] = {}
+    evictions = 0
 
     def inc(self, n=1) -> None: ...
     def dec(self, n=1) -> None: ...
@@ -220,6 +244,14 @@ class Registry:
         self.trace: TraceTable = (
             TraceTable(trace_cap) if enabled else _NULL  # type: ignore
         )
+        # Attached HealthMonitor (node/main.py wires one per process);
+        # snapshots then carry a `health` section and the MetricsServer
+        # answers /healthz from it.
+        self.health: Optional["HealthMonitor"] = None
+        if enabled:
+            self.gauge_fn(
+                "metrics.trace_evictions", lambda: self.trace.evictions
+            )
 
     def counter(self, name: str) -> Counter:
         if not self.enabled:
@@ -275,6 +307,10 @@ class Registry:
             h.count = 0
         if self.enabled:
             self.trace.entries.clear()
+            self.trace.evictions = 0
+        # A monitor attached by a previous test would otherwise keep
+        # reporting rule state over the zeroed instruments.
+        self.health = None
 
     # -- export --------------------------------------------------------------
 
@@ -325,6 +361,10 @@ class Registry:
                 else {}
             ),
         }
+        if self.health is not None:
+            health = call("health", self.health.health_snapshot)
+            if health is not None:
+                snap["health"] = health
         if errors:
             snap["errors"] = errors
         return snap
@@ -335,7 +375,11 @@ class Registry:
         ``_total`` suffix, histograms the ``_bucket/_sum/_count`` triple."""
 
         def mangle(name: str) -> str:
-            return "narwhal_" + name.replace(".", "_").replace("-", "_")
+            # ':' covers per-peer instruments whose names embed a peer
+            # address (net.reliable.peer.*.<host:port>).
+            return "narwhal_" + (
+                name.replace(".", "_").replace("-", "_").replace(":", "_")
+            )
 
         lines: List[str] = []
         for n, c in sorted(self.counters.items()):
@@ -361,6 +405,416 @@ class Registry:
             lines.append(f"{m}_sum {h.sum}")
             lines.append(f"{m}_count {h.count}")
         return "\n".join(lines) + "\n"
+
+
+# -- live health: declarative anomaly rules over the registry -----------------
+
+class HealthRule:
+    """One anomaly rule with hysteresis.
+
+    ``check(ctx)`` returns ``{subject: detail}`` for every breaching
+    subject — ``""`` for node-wide rules, a peer address for per-peer
+    rules — where ``detail`` is a small JSON dict (observed value,
+    threshold).  The monitor owns the hysteresis: a subject must breach
+    ``for_intervals`` consecutive evaluations to start FIRING and pass
+    ``clear_intervals`` consecutive clean evaluations to clear, so one
+    noisy sample can neither raise nor silence an anomaly (no flapping).
+
+    ``series`` names counters/gauges whose history the monitor must keep
+    (exact names or ``prefix.*`` patterns) so the rule can ask for rates
+    and change ages; rules reading only instantaneous values leave it
+    empty.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        check: Callable[["HealthContext"], Dict[str, dict]],
+        for_intervals: int = 1,
+        clear_intervals: int = 2,
+        series: Sequence[str] = (),
+    ) -> None:
+        self.name = name
+        self.check = check
+        self.for_intervals = max(1, for_intervals)
+        self.clear_intervals = max(1, clear_intervals)
+        self.series = tuple(series)
+
+
+def _lookup_value(reg: Registry, name: str) -> Optional[float]:
+    """One definition of the instrument-resolution chain every health
+    read uses: counter → plain gauge → callback gauge (a failing
+    callback reads as absent, same policy as the snapshot path)."""
+    c = reg.counters.get(name)
+    if c is not None:
+        return float(c.value)
+    g = reg.gauges.get(name)
+    if g is not None:
+        return float(g.value)
+    fn = reg.gauge_fns.get(name)
+    if fn is not None:
+        try:
+            return float(fn())
+        except Exception:
+            return None
+    return None
+
+
+class HealthContext:
+    """What a rule's ``check`` sees: instantaneous registry values plus
+    the monitor's sampled history (rates, change ages)."""
+
+    def __init__(self, monitor: "HealthMonitor", now: float) -> None:
+        self._m = monitor
+        self.now = now
+
+    def counter(self, name: str) -> Optional[float]:
+        c = self._m.registry.counters.get(name)
+        return float(c.value) if c is not None else None
+
+    def gauge(self, name: str) -> Optional[float]:
+        g = self._m.registry.gauges.get(name)
+        if g is not None or name in self._m.registry.gauge_fns:
+            return _lookup_value(self._m.registry, name)
+        return None
+
+    def gauges_prefixed(self, prefix: str) -> Dict[str, float]:
+        """{suffix: value} for every plain gauge under ``prefix``."""
+        return {
+            n[len(prefix):]: float(g.value)
+            for n, g in self._m.registry.gauges.items()
+            if n.startswith(prefix)
+        }
+
+    def rate(self, name: str, window_s: float) -> Optional[float]:
+        """Per-second net change of a sampled series over ``window_s``.
+        None until the history actually SPANS the window: a rate
+        computed over a shorter early span would over-weight one bursty
+        tick (e.g. boot-time reconnect retransmissions) against a
+        threshold tuned for the full window — rules stay silent for the
+        first ``window_s`` after monitor start instead of false-firing.
+        """
+        hist = self._m._history.get(name)
+        if not hist or len(hist) < 2:
+            return None
+        newest_t, newest_v = hist[-1]
+        for t, v in reversed(hist):
+            if newest_t - t >= window_s:
+                return (newest_v - v) / (newest_t - t)
+        return None
+
+    def rates_prefixed(
+        self, prefix: str, window_s: float
+    ) -> Dict[str, float]:
+        out = {}
+        for name in self._m._history:
+            if name.startswith(prefix):
+                r = self.rate(name, window_s)
+                if r is not None:
+                    out[name[len(prefix):]] = r
+        return out
+
+    def last_change_age(self, name: str) -> Optional[float]:
+        """Seconds since the sampled series last changed value (first
+        sample counts as a change, so the age is bounded by monitor
+        uptime)."""
+        rec = self._m._last_change.get(name)
+        if rec is None:
+            return None
+        return self.now - rec[1]
+
+
+def default_rules(env: Optional[Mapping[str, str]] = None) -> List[HealthRule]:
+    """The built-in rule set; every threshold has a NARWHAL_HEALTH_* env
+    override (documented in README 'Observability')."""
+    env = os.environ if env is None else env
+
+    def f(key: str, default: float) -> float:
+        return float(env.get(key, default))
+
+    lag_max = f("NARWHAL_HEALTH_MAX_COMMIT_LAG", 20)
+    stall_s = f("NARWHAL_HEALTH_COMMIT_STALL_S", 10)
+    ack_floor = f("NARWHAL_HEALTH_PENDING_ACK_FLOOR", 512)
+    ack_window = f("NARWHAL_HEALTH_PENDING_ACK_WINDOW_S", 5)
+    retrans_max = f("NARWHAL_HEALTH_PEER_RETRANS_RATE", 10)
+    retrans_window = f("NARWHAL_HEALTH_PEER_RETRANS_WINDOW_S", 5)
+    peer_failures = f("NARWHAL_HEALTH_PEER_FAILURES", 3)
+
+    def commit_lag(ctx: HealthContext) -> Dict[str, dict]:
+        v = ctx.gauge("consensus.commit_lag_rounds")
+        if v is not None and v > lag_max:
+            return {"": {"commit_lag_rounds": v, "threshold": lag_max}}
+        return {}
+
+    def commit_stall(ctx: HealthContext) -> Dict[str, dict]:
+        # Guarded on round > 2: a freshly booted or idle committee has
+        # legitimately committed nothing yet; once the DAG is past its
+        # first leader round, zero commit progress means a wedge.
+        rnd = ctx.gauge("primary.round")
+        if rnd is None or rnd <= 2:
+            return {}
+        age = ctx.last_change_age("consensus.committed_certificates")
+        if age is not None and age > stall_s:
+            return {
+                "": {
+                    "seconds_without_commit": round(age, 1),
+                    "threshold": stall_s,
+                    "round": rnd,
+                }
+            }
+        return {}
+
+    def pending_acks(ctx: HealthContext) -> Dict[str, dict]:
+        v = ctx.gauge("net.reliable.pending_acks")
+        if v is None or v < ack_floor:
+            return {}
+        growth = ctx.rate("net.reliable.pending_acks", ack_window)
+        if growth is not None and growth > 0:
+            return {
+                "": {
+                    "pending_acks": v,
+                    "floor": ack_floor,
+                    "growth_per_s": round(growth, 2),
+                }
+            }
+        return {}
+
+    def peer_retransmissions(ctx: HealthContext) -> Dict[str, dict]:
+        out = {}
+        for peer, rate in ctx.rates_prefixed(
+            "net.reliable.peer.retransmissions.", retrans_window
+        ).items():
+            if rate > retrans_max:
+                out[peer] = {
+                    "retransmissions_per_s": round(rate, 2),
+                    "threshold": retrans_max,
+                }
+        return out
+
+    def peer_unreachable(ctx: HealthContext) -> Dict[str, dict]:
+        out = {}
+        for peer, v in ctx.gauges_prefixed(
+            "net.reliable.peer.consecutive_failures."
+        ).items():
+            if v >= peer_failures:
+                out[peer] = {
+                    "consecutive_failures": v,
+                    "threshold": peer_failures,
+                }
+        return out
+
+    return [
+        HealthRule("commit_lag", commit_lag, for_intervals=2),
+        HealthRule(
+            "commit_stall",
+            commit_stall,
+            series=("consensus.committed_certificates",),
+        ),
+        HealthRule(
+            "pending_ack_growth",
+            pending_acks,
+            for_intervals=2,
+            series=("net.reliable.pending_acks",),
+        ),
+        HealthRule(
+            "peer_retransmission_spike",
+            peer_retransmissions,
+            for_intervals=2,
+            series=("net.reliable.peer.retransmissions.*",),
+        ),
+        # for_intervals=1: a dead peer must be named within ONE
+        # evaluation interval of the failure gauge crossing the
+        # threshold (the failover tier-1 test pins this down).
+        HealthRule("peer_unreachable", peer_unreachable, for_intervals=1),
+    ]
+
+
+class HealthMonitor:
+    """Evaluates a rule set over the registry on a timer.
+
+    Each evaluation samples the watched series (for rates and change
+    ages), runs every rule, applies hysteresis per (rule, subject), and
+    on FIRING/cleared transitions emits one structured anomaly event —
+    a WARNING/INFO log line prefixed ``HEALTH`` plus an entry in the
+    bounded ``events`` ring.  ``health_snapshot()`` is what lands in the
+    registry snapshot's ``health`` section and behind ``/healthz``:
+
+        {"status": "ok"|"failing", "evaluations": N, "interval_s": s,
+         "firing": [{"rule", "subject", "since", "detail"}, …],
+         "events": [last 64 transitions]}
+
+    Not spawned by default: node/main.py attaches one per process
+    (``registry().health = monitor``) unless NARWHAL_HEALTH=0.
+    """
+
+    HISTORY_CAP = 128  # samples kept per watched series
+
+    def __init__(
+        self,
+        reg: Registry,
+        rules: Optional[List[HealthRule]] = None,
+        interval_s: Optional[float] = None,
+    ) -> None:
+        self.registry = reg
+        self.rules = default_rules() if rules is None else rules
+        self.interval_s = (
+            float(os.environ.get("NARWHAL_HEALTH_INTERVAL", "1.0"))
+            if interval_s is None
+            else interval_s
+        )
+        self.evaluations = 0
+        self.events: Deque[dict] = collections.deque(maxlen=64)
+        # (rule, subject) -> {breaches, oks, firing, since, detail}
+        self._state: Dict[Tuple[str, str], dict] = {}
+        self._history: Dict[str, Deque[Tuple[float, float]]] = {}
+        self._last_change: Dict[str, Tuple[float, float]] = {}  # (value, t)
+        self._watch_names: List[str] = []
+        self._watch_prefixes: List[str] = []
+        for rule in self.rules:
+            for s in rule.series:
+                if s.endswith(".*"):
+                    self._watch_prefixes.append(s[:-1])  # keep the dot
+                else:
+                    self._watch_names.append(s)
+
+    # -- sampling -------------------------------------------------------------
+
+    def _watched_values(self) -> Dict[str, float]:
+        reg = self.registry
+        out: Dict[str, float] = {}
+        for name in self._watch_names:
+            v = _lookup_value(reg, name)
+            if v is not None:
+                out[name] = v
+        for prefix in self._watch_prefixes:
+            for pool in (reg.counters, reg.gauges):
+                for name, inst in pool.items():
+                    if name.startswith(prefix):
+                        out[name] = float(inst.value)
+        return out
+
+    def _sample(self, now: float) -> None:
+        for name, v in self._watched_values().items():
+            hist = self._history.get(name)
+            if hist is None:
+                hist = self._history[name] = collections.deque(
+                    maxlen=self.HISTORY_CAP
+                )
+            hist.append((now, v))
+            last = self._last_change.get(name)
+            if last is None or last[0] != v:
+                self._last_change[name] = (v, now)
+
+    # -- evaluation -----------------------------------------------------------
+
+    def evaluate(self, now: Optional[float] = None) -> List[dict]:
+        """One evaluation pass; returns the currently-firing anomalies.
+        ``now`` is injectable so tests drive rate windows and stall ages
+        deterministically."""
+        now = time.time() if now is None else now
+        self._sample(now)
+        ctx = HealthContext(self, now)
+        for rule in self.rules:
+            try:
+                breaches = rule.check(ctx)
+            except Exception:
+                # A rule crashing on a half-torn-down registry must not
+                # kill the monitor loop.
+                log.exception("health rule %s failed to evaluate", rule.name)
+                continue
+            subjects = set(breaches)
+            subjects.update(
+                s for (r, s) in self._state if r == rule.name
+            )
+            for subject in subjects:
+                key = (rule.name, subject)
+                st = self._state.get(key)
+                if st is None:
+                    st = self._state[key] = {
+                        "breaches": 0,
+                        "oks": 0,
+                        "firing": False,
+                        "since": None,
+                        "detail": {},
+                    }
+                if subject in breaches:
+                    st["breaches"] += 1
+                    st["oks"] = 0
+                    st["detail"] = breaches[subject]
+                    if (
+                        not st["firing"]
+                        and st["breaches"] >= rule.for_intervals
+                    ):
+                        st["firing"] = True
+                        st["since"] = now
+                        self._transition("FIRING", rule.name, subject, st, now)
+                else:
+                    st["oks"] += 1
+                    st["breaches"] = 0
+                    if st["firing"] and st["oks"] >= rule.clear_intervals:
+                        st["firing"] = False
+                        self._transition(
+                            "cleared", rule.name, subject, st, now
+                        )
+                        st["since"] = None
+                    if not st["firing"] and st["oks"] >= rule.clear_intervals:
+                        # Fully quiet subject: drop it so per-peer state
+                        # stays bounded over churn.
+                        self._state.pop(key, None)
+        self.evaluations += 1
+        return self.firing()
+
+    def _transition(
+        self, kind: str, rule: str, subject: str, st: dict, now: float
+    ) -> None:
+        # `now` is the evaluation clock (injectable in tests), so event
+        # timestamps join against the firing entries' `since` values.
+        event = {
+            "event": kind,
+            "rule": rule,
+            "subject": subject,
+            "t": round(now, 3),
+            "detail": dict(st["detail"]),
+        }
+        self.events.append(event)
+        msg = "HEALTH anomaly %s rule=%s%s detail=%s"
+        sub = f" subject={subject}" if subject else ""
+        if kind == "FIRING":
+            log.warning(msg, kind, rule, sub, json.dumps(st["detail"]))
+        else:
+            log.info(msg, kind, rule, sub, json.dumps(st["detail"]))
+
+    # -- export ---------------------------------------------------------------
+
+    def firing(self) -> List[dict]:
+        return [
+            {
+                "rule": rule,
+                "subject": subject,
+                "since": st["since"],
+                "detail": dict(st["detail"]),
+            }
+            for (rule, subject), st in sorted(self._state.items())
+            if st["firing"]
+        ]
+
+    def ok(self) -> bool:
+        return not any(st["firing"] for st in self._state.values())
+
+    def health_snapshot(self) -> dict:
+        firing = self.firing()
+        return {
+            "status": "ok" if not firing else "failing",
+            "evaluations": self.evaluations,
+            "interval_s": self.interval_s,
+            "firing": firing,
+            "events": list(self.events),
+        }
+
+    async def run(self) -> None:
+        while True:
+            await asyncio.sleep(self.interval_s)
+            self.evaluate()
 
 
 # -- the per-process default registry ----------------------------------------
@@ -479,7 +933,11 @@ class SnapshotWriter:
 
 class MetricsServer:
     """Minimal HTTP server: ``GET /metrics`` → Prometheus text,
-    ``GET /metrics.json`` → the JSON snapshot.  Anything else is 404.
+    ``GET /metrics.json`` → the JSON snapshot (``?trace=0`` omits the
+    heavyweight stage-trace table — what the bench scraper polls at
+    1 Hz), ``GET /healthz`` → 200/503 + the attached HealthMonitor's
+    JSON (503 iff any rule is firing; 200 with ``status: unmonitored``
+    when no monitor is attached).  Anything else is 404.
 
     Hand-rolled over ``asyncio.start_server`` — the container bakes no
     http framework, and a scrape endpoint needs exactly one request per
@@ -530,14 +988,36 @@ class MetricsServer:
                     break
             else:
                 return  # header flood; drop the connection
-            if target == "/metrics":
+            path, _, query = target.partition("?")
+            params = dict(
+                kv.split("=", 1) for kv in query.split("&") if "=" in kv
+            )
+            if path == "/metrics":
                 body = self.registry.render_prometheus().encode()
                 ctype = "text/plain; version=0.0.4; charset=utf-8"
                 status = "200 OK"
-            elif target == "/metrics.json":
-                body = json.dumps(self.registry.snapshot()).encode()
+            elif path == "/metrics.json":
+                body = json.dumps(
+                    self.registry.snapshot(
+                        include_trace=params.get("trace") != "0"
+                    )
+                ).encode()
                 ctype = "application/json"
                 status = "200 OK"
+            elif path == "/healthz":
+                monitor = self.registry.health
+                if monitor is None:
+                    payload: dict = {"status": "unmonitored", "firing": []}
+                    status = "200 OK"
+                else:
+                    payload = monitor.health_snapshot()
+                    status = (
+                        "200 OK"
+                        if payload["status"] == "ok"
+                        else "503 Service Unavailable"
+                    )
+                body = json.dumps(payload).encode()
+                ctype = "application/json"
             else:
                 body = b"not found\n"
                 ctype = "text/plain"

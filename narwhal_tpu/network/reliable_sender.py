@@ -34,6 +34,9 @@ _BACKOFF_START = 0.2
 _BACKOFF_CAP = 60.0
 
 _Item = Tuple[bytes, asyncio.Future]
+# Pending (written, awaiting ACK) items additionally carry the write
+# timestamp, so each ACK yields a per-peer round-trip observation.
+_Pending = Tuple[bytes, asyncio.Future, float]
 
 # Counters are shared by every ReliableSender in the process (one registry
 # per process); the per-peer detail below disaggregates when needed.
@@ -72,6 +75,26 @@ metrics.detail_fn(
 )
 
 
+def _peer_instruments(address: str):
+    """Per-peer instruments, memoized by name in the process registry so
+    every sender talking to the same peer shares them.  These are what
+    lets a health rule (or a human) name WHICH validator is slow:
+
+    - ``net.reliable.peer.rtt_seconds.<addr>`` — ACK round-trip
+      histogram (write → ACK, so it includes the peer's validation);
+    - ``net.reliable.peer.retransmissions.<addr>`` — counter;
+    - ``net.reliable.peer.consecutive_failures.<addr>`` — gauge, reset
+      to 0 on a successful connect (the peer_unreachable rule's input);
+    - ``net.reliable.peer.backing_off.<addr>`` — 0/1 gauge.
+    """
+    return (
+        metrics.histogram(f"net.reliable.peer.rtt_seconds.{address}"),
+        metrics.counter(f"net.reliable.peer.retransmissions.{address}"),
+        metrics.gauge(f"net.reliable.peer.consecutive_failures.{address}"),
+        metrics.gauge(f"net.reliable.peer.backing_off.{address}"),
+    )
+
+
 class _Connection:
     """Owns the channel to one peer: buffered retransmission until ACK.
 
@@ -86,9 +109,16 @@ class _Connection:
     def __init__(self, address: str) -> None:
         self.address = address
         self.buffer: Deque[_Item] = collections.deque()
-        self.pending: Deque[_Item] = collections.deque()
+        self.pending: Deque[_Pending] = collections.deque()
         self.wakeup = asyncio.Event()
         self.backing_off = False  # reconnect backoff state (metrics gauge)
+        self.failures = 0  # consecutive connect failures (health rule input)
+        (
+            self._m_rtt,
+            self._m_peer_retrans,
+            self._g_failures,
+            self._g_backoff,
+        ) = _peer_instruments(address)
         self.task = asyncio.get_running_loop().create_task(self._keep_alive())
 
     def push(self, data: bytes, fut: asyncio.Future) -> None:
@@ -97,9 +127,9 @@ class _Connection:
 
     def abort_all(self) -> None:
         """Fail every outstanding delivery (sender shutdown)."""
-        for data, fut in list(self.pending) + list(self.buffer):
-            if not fut.done():
-                fut.cancel()
+        for item in list(self.pending) + list(self.buffer):
+            if not item[1].done():
+                item[1].cancel()
         self.pending.clear()
         self.buffer.clear()
 
@@ -107,12 +137,13 @@ class _Connection:
         """Move un-ACKed items back to the front of the buffer, oldest first,
         dropping messages whose caller gave up (cancelled future)."""
         while self.pending:
-            item = self.pending.pop()
-            if not item[1].cancelled():
-                self.buffer.appendleft(item)
+            data, fut, _t0 = self.pending.pop()
+            if not fut.cancelled():
+                self.buffer.appendleft((data, fut))
                 # Written once, un-ACKed, will be written again: that is a
                 # retransmission, the signal a flapping/slow peer leaves.
                 _m_retrans.inc()
+                self._m_peer_retrans.inc()
 
     async def _keep_alive(self) -> None:
         host, port = parse_address(self.address)
@@ -128,11 +159,17 @@ class _Connection:
                     log.debug("ReliableSender: cannot reach %s: %s", self.address, e)
                     _m_connect_fail.inc()
                     self.backing_off = True
+                    self.failures += 1
+                    self._g_failures.set(self.failures)
+                    self._g_backoff.set(1)
                     await asyncio.sleep(delay)
                     delay = min(delay * 2, _BACKOFF_CAP)
                     continue
                 delay = _BACKOFF_START
                 self.backing_off = False
+                self.failures = 0
+                self._g_failures.set(0)
+                self._g_backoff.set(0)
                 try:
                     await self._exchange(reader, writer)
                 except (ConnectionError, OSError, asyncio.IncompleteReadError) as e:
@@ -148,6 +185,8 @@ class _Connection:
     ) -> None:
         """Pipeline writes from the buffer; match ACK frames FIFO."""
 
+        loop = asyncio.get_running_loop()
+
         async def write_loop() -> None:
             while True:
                 while self.buffer:
@@ -157,7 +196,7 @@ class _Connection:
                     # Into `pending` BEFORE the await: if the write (or this
                     # task) dies mid-frame, reconnect retransmits it rather
                     # than losing the message and wedging its future.
-                    self.pending.append((data, fut))
+                    self.pending.append((data, fut, loop.time()))
                     await write_frame(writer, data)
                     # Counted after the write returns (same convention as
                     # SimpleSender): a frame lost to a mid-write disconnect
@@ -174,7 +213,8 @@ class _Connection:
                 # Exactly one pending entry per ACK frame — the peer ACKs
                 # everything we wrote, including since-cancelled messages.
                 if self.pending:
-                    _, fut = self.pending.popleft()
+                    _, fut, t0 = self.pending.popleft()
+                    self._m_rtt.observe(loop.time() - t0)
                     if not fut.done():
                         fut.set_result(ack)
 

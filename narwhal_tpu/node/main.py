@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import json
 import logging
 import os
 import signal
@@ -20,7 +21,40 @@ from ..crypto import KeyPair
 from .node import spawn_primary_node, spawn_worker_node
 
 
-def setup_logging(verbosity: int, level_name: str | None = None) -> None:
+class JsonLogFormatter(logging.Formatter):
+    """One-line-JSON log records: {ts, level, logger, msg, node} (+exc).
+
+    ``ts`` is unix epoch seconds (float) so log events join directly
+    against the metrics time-series and scraper timeline, which all use
+    ``time.time()`` — no timestamp re-parsing.  ``node`` identifies the
+    process in a committee-wide merged stream (role + worker id + key
+    prefix).  HealthMonitor anomaly lines come through here too, which is
+    the point: one machine-joinable event stream per node.
+    """
+
+    def __init__(self, node_id: str) -> None:
+        super().__init__()
+        self.node_id = node_id
+
+    def format(self, record: logging.LogRecord) -> str:
+        entry = {
+            "ts": round(record.created, 3),
+            "level": record.levelname,
+            "logger": record.name,
+            "msg": record.getMessage(),
+            "node": self.node_id,
+        }
+        if record.exc_info:
+            entry["exc"] = self.formatException(record.exc_info)
+        return json.dumps(entry)
+
+
+def setup_logging(
+    verbosity: int,
+    level_name: str | None = None,
+    json_logs: bool = False,
+    node_id: str = "",
+) -> None:
     # Explicit --log-level (or the NARWHAL_LOG env var) wins over -v; the
     # level is applied to the whole `narwhal.*` hierarchy — every module
     # logs under it (narwhal.worker, narwhal.primary, narwhal.consensus,
@@ -33,7 +67,9 @@ def setup_logging(verbosity: int, level_name: str | None = None) -> None:
     else:
         level = [logging.ERROR, logging.INFO, logging.DEBUG][min(verbosity, 2)]
     # Millisecond timestamps: the benchmark log parser depends on them
-    # (reference main.rs:54-55).
+    # (reference main.rs:54-55).  --log-json swaps the formatter for the
+    # machine-joinable one-line-JSON form; the human format stays the
+    # default (and is what the bench log parser requires).
     logging.basicConfig(
         level=level,
         format="%(asctime)s.%(msecs)03dZ %(levelname)s %(name)s %(message)s",
@@ -41,6 +77,10 @@ def setup_logging(verbosity: int, level_name: str | None = None) -> None:
         stream=sys.stderr,
         force=True,
     )
+    if json_logs:
+        formatter = JsonLogFormatter(node_id)
+        for handler in logging.getLogger().handlers:
+            handler.setFormatter(formatter)
     logging.getLogger("narwhal").setLevel(level)
 
 
@@ -57,6 +97,15 @@ def main(argv=None) -> int:
         help="Log level for the whole narwhal.* hierarchy (overrides -v; "
         "the NARWHAL_LOG env var is the equivalent knob for harnesses "
         "that cannot edit the command line)",
+    )
+    parser.add_argument(
+        "--log-json",
+        action="store_true",
+        default=False,
+        help="Emit one-line-JSON log records ({ts, level, logger, msg, "
+        "node}, ts = unix epoch) instead of the human format, so anomaly "
+        "events and logs join machine-side with the metrics time-series. "
+        "The bench log parser requires the human default.",
     )
     sub = parser.add_subparsers(dest="command", required=True)
 
@@ -105,7 +154,18 @@ def main(argv=None) -> int:
         type=int,
         default=0,
         help="Serve Prometheus text metrics on this port (GET /metrics; "
-        "GET /metrics.json for the snapshot form).  0 = disabled.",
+        "GET /metrics.json for the snapshot form, ?trace=0 to omit the "
+        "stage-trace table; GET /healthz for the 200/503 anomaly-rule "
+        "verdict).  0 = disabled.",
+    )
+    run.add_argument(
+        "--health-interval",
+        type=float,
+        default=None,
+        help="Seconds between health-rule evaluations (default 1.0, or "
+        "the NARWHAL_HEALTH_INTERVAL env var).  NARWHAL_HEALTH=0 "
+        "disables the monitor entirely; rule thresholds are tuned via "
+        "NARWHAL_HEALTH_* env vars (see README 'Observability').",
     )
     runsub = run.add_subparsers(dest="role", required=True)
     runsub.add_parser("primary", help="Run a single primary")
@@ -169,8 +229,16 @@ def main(argv=None) -> int:
             log.info("Consensus kernel ready")
         return 0
 
-    setup_logging(args.verbosity, args.log_level)
+    # Keypair first: the JSON log formatter stamps every record with a
+    # node id derived from it (role + worker id + key prefix).
     keypair = load_keypair(args.keys)
+    node_id = f"{args.role}-{keypair.name.encode_base64()[:8]}"
+    if args.role == "worker":
+        node_id = f"{args.role}{args.id}-{keypair.name.encode_base64()[:8]}"
+    setup_logging(
+        args.verbosity, args.log_level, json_logs=args.log_json,
+        node_id=node_id,
+    )
     committee = Committee.load(args.committee)
     parameters = (
         Parameters.load(args.parameters) if args.parameters else Parameters()
@@ -194,6 +262,7 @@ def main(argv=None) -> int:
 
         snapshot_task = None
         metrics_server = None
+        health_task = None
         if args.metrics_path:
             snapshot_task = asyncio.get_running_loop().create_task(
                 _metrics.SnapshotWriter(
@@ -201,6 +270,20 @@ def main(argv=None) -> int:
                     args.metrics_path,
                     interval_s=args.metrics_interval,
                 ).run()
+            )
+        # Live health: always on when metrics are (cost: one rule sweep
+        # per interval).  Attached to the registry so snapshots carry a
+        # `health` section and /healthz answers from it.
+        if (
+            _metrics.registry().enabled
+            and os.environ.get("NARWHAL_HEALTH", "1") != "0"
+        ):
+            monitor = _metrics.HealthMonitor(
+                _metrics.registry(), interval_s=args.health_interval
+            )
+            _metrics.registry().health = monitor
+            health_task = asyncio.get_running_loop().create_task(
+                monitor.run()
             )
         if args.metrics_port:
             metrics_server = await _metrics.MetricsServer.spawn(
@@ -231,6 +314,9 @@ def main(argv=None) -> int:
             await node.shutdown()
             if metrics_server is not None:
                 await metrics_server.shutdown()
+            if health_task is not None:
+                health_task.cancel()
+                await asyncio.gather(health_task, return_exceptions=True)
             if snapshot_task is not None:
                 # Cancellation triggers the writer's final flush, so the
                 # snapshot on disk covers the whole run.

@@ -1,0 +1,383 @@
+"""Unit tests for the live-health layer (narwhal_tpu/metrics.py
+HealthMonitor): hysteresis (no flapping), rate-rule windows, the built-in
+default rules, /healthz 200↔503 transitions, per-peer instruments from the
+reliable sender, and the bench scraper against a canned MetricsServer."""
+
+import asyncio
+import json
+import os
+import sys
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from narwhal_tpu import metrics  # noqa: E402
+from narwhal_tpu.metrics import (  # noqa: E402
+    HealthMonitor,
+    HealthRule,
+    MetricsServer,
+    Registry,
+    default_rules,
+)
+
+
+def _ceiling_rule(limit=10, **kw):
+    def check(ctx):
+        v = ctx.gauge("t.val")
+        if v is not None and v > limit:
+            return {"": {"value": v, "threshold": limit}}
+        return {}
+
+    return HealthRule("ceiling", check, **kw)
+
+
+# -- hysteresis ---------------------------------------------------------------
+
+def test_hysteresis_fires_after_for_intervals_and_no_flapping():
+    reg = Registry()
+    g = reg.gauge("t.val")
+    mon = HealthMonitor(
+        reg,
+        rules=[_ceiling_rule(for_intervals=2, clear_intervals=2)],
+        interval_s=1.0,
+    )
+    t = 1000.0
+    assert mon.evaluate(t) == []
+    # One breaching sample must NOT fire (for_intervals=2).
+    g.set(11)
+    assert mon.evaluate(t + 1) == []
+    # Second consecutive breach fires.
+    firing = mon.evaluate(t + 2)
+    assert [f["rule"] for f in firing] == ["ceiling"]
+    assert firing[0]["since"] == t + 2
+    assert firing[0]["detail"]["value"] == 11
+    # One clean sample must NOT clear (clear_intervals=2) ...
+    g.set(0)
+    assert mon.evaluate(t + 3), "cleared after a single clean interval"
+    # ... and a re-breach resets the clean streak without re-firing.
+    g.set(11)
+    assert mon.evaluate(t + 4)
+    assert sum(1 for e in mon.events if e["event"] == "FIRING") == 1
+    # Two consecutive clean samples clear.
+    g.set(0)
+    mon.evaluate(t + 5)
+    assert mon.evaluate(t + 6) == []
+    kinds = [e["event"] for e in mon.events]
+    assert kinds == ["FIRING", "cleared"]  # exactly one cycle — no flap
+    assert mon.ok()
+
+
+def test_single_interval_spike_never_fires():
+    reg = Registry()
+    g = reg.gauge("t.val")
+    mon = HealthMonitor(
+        reg, rules=[_ceiling_rule(for_intervals=2)], interval_s=1.0
+    )
+    t = 0.0
+    for i in range(6):
+        g.set(11 if i % 2 == 0 else 0)  # alternating spike
+        assert mon.evaluate(t + i) == []
+    assert list(mon.events) == []
+
+
+# -- rate windows -------------------------------------------------------------
+
+def test_rate_rule_window_rises_and_slides_back_down():
+    reg = Registry()
+    c = reg.counter("t.events")
+
+    def check(ctx):
+        r = ctx.rate("t.events", 5.0)
+        if r is not None and r > 10:
+            return {"": {"rate": r}}
+        return {}
+
+    mon = HealthMonitor(
+        reg,
+        rules=[HealthRule("rate", check, series=("t.events",))],
+        interval_s=1.0,
+    )
+    # History must SPAN the 5 s window before a rate exists at all — an
+    # early burst must not be judged against a full-window threshold.
+    assert mon.evaluate(0.0) == []  # single sample: no rate yet
+    c.inc(100)
+    for t in (1.0, 2.0, 3.0, 4.0):
+        assert mon.evaluate(t) == [], f"fired before window spanned at {t}"
+    # At t=5 the window is spanned: 100 events over 5 s = 20/s > 10.
+    firing = mon.evaluate(5.0)
+    assert [f["rule"] for f in firing] == ["rate"]
+    # No further events: the burst slides out of the 5 s window and the
+    # rule clears (after clear_intervals clean evaluations).
+    cleared = None
+    for i in range(6, 16):
+        if mon.evaluate(float(i)) == []:
+            cleared = i
+            break
+    assert cleared is not None and cleared <= 14
+
+
+def test_last_change_age_drives_commit_stall_rule():
+    reg = Registry()
+    reg.gauge("primary.round").set(5)
+    commits = reg.counter("consensus.committed_certificates")
+    commits.inc(3)
+    mon = HealthMonitor(
+        reg,
+        rules=default_rules({"NARWHAL_HEALTH_COMMIT_STALL_S": "10"}),
+        interval_s=1.0,
+    )
+    t = 50.0
+    assert mon.evaluate(t) == []
+    # 11 s with zero commit progress past round 2 → stall fires.
+    firing = mon.evaluate(t + 11)
+    assert [f["rule"] for f in firing] == ["commit_stall"]
+    # A commit resets the change age and the rule clears.
+    commits.inc()
+    mon.evaluate(t + 12)
+    assert mon.evaluate(t + 13) == []
+
+
+def test_commit_stall_guarded_before_round_2():
+    reg = Registry()
+    reg.gauge("primary.round").set(1)  # freshly booted committee
+    reg.counter("consensus.committed_certificates")
+    mon = HealthMonitor(reg, rules=default_rules(), interval_s=1.0)
+    mon.evaluate(0.0)
+    assert mon.evaluate(1000.0) == []  # idle forever, still healthy
+
+
+def test_peer_unreachable_names_the_peer():
+    reg = Registry()
+    reg.gauge("net.reliable.peer.consecutive_failures.10.0.0.9:7001").set(3)
+    mon = HealthMonitor(
+        reg,
+        rules=default_rules({"NARWHAL_HEALTH_PEER_FAILURES": "3"}),
+        interval_s=1.0,
+    )
+    firing = mon.evaluate()
+    assert [f["rule"] for f in firing] == ["peer_unreachable"]
+    assert firing[0]["subject"] == "10.0.0.9:7001"
+    # Recovery: failures reset to 0 on a successful connect.
+    reg.gauge("net.reliable.peer.consecutive_failures.10.0.0.9:7001").set(0)
+    mon.evaluate()
+    assert mon.evaluate() == []
+
+
+# -- /healthz -----------------------------------------------------------------
+
+async def _fetch(port, target):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    writer.write(f"GET {target} HTTP/1.1\r\nHost: x\r\n\r\n".encode())
+    await writer.drain()
+    data = await reader.read()
+    writer.close()
+    return data
+
+
+def test_healthz_transitions_200_503_200():
+    reg = Registry()
+    g = reg.gauge("t.val")
+    mon = HealthMonitor(
+        reg,
+        rules=[_ceiling_rule(for_intervals=1, clear_intervals=1)],
+        interval_s=1.0,
+    )
+    reg.health = mon
+
+    async def go():
+        server = await MetricsServer.spawn(reg, 0, host="127.0.0.1")
+        try:
+            mon.evaluate(0.0)
+            ok = await _fetch(server.port, "/healthz")
+            assert b"200 OK" in ok
+            assert json.loads(ok.split(b"\r\n\r\n", 1)[1])["status"] == "ok"
+
+            g.set(99)
+            mon.evaluate(1.0)
+            bad = await _fetch(server.port, "/healthz")
+            assert b"503" in bad
+            body = json.loads(bad.split(b"\r\n\r\n", 1)[1])
+            assert body["status"] == "failing"
+            assert [f["rule"] for f in body["firing"]] == ["ceiling"]
+
+            g.set(0)
+            mon.evaluate(2.0)
+            again = await _fetch(server.port, "/healthz")
+            assert b"200 OK" in again
+            # The health section also rides in the registry snapshot.
+            assert reg.snapshot()["health"]["status"] == "ok"
+        finally:
+            await server.shutdown()
+
+    asyncio.run(asyncio.wait_for(go(), 15))
+
+
+def test_healthz_unmonitored_is_200():
+    reg = Registry()
+
+    async def go():
+        server = await MetricsServer.spawn(reg, 0, host="127.0.0.1")
+        try:
+            resp = await _fetch(server.port, "/healthz")
+            assert b"200 OK" in resp
+            body = json.loads(resp.split(b"\r\n\r\n", 1)[1])
+            assert body["status"] == "unmonitored"
+        finally:
+            await server.shutdown()
+
+    asyncio.run(asyncio.wait_for(go(), 15))
+
+
+# -- per-peer reliable-sender instruments -------------------------------------
+
+def test_reliable_sender_per_peer_rtt_and_failure_gauges():
+    """A real send/ACK exchange must land per-peer observations under
+    names carrying the peer address; a dead peer must accumulate the
+    consecutive-failure gauge the peer_unreachable rule reads."""
+    from narwhal_tpu.network import Receiver, ReliableSender
+    from tests.test_network import EchoAckHandler
+
+    reg = metrics.registry()
+    reg.reset()
+
+    async def go():
+        recv = await Receiver.spawn("127.0.0.1:0", EchoAckHandler())
+        addr = f"127.0.0.1:{recv.port}"
+        sender = ReliableSender()
+        ack = await asyncio.wait_for(sender.send(addr, b"ping"), 5)
+        assert ack == b"Ack"
+
+        # Dead peer: unused port; connect failures accrue with backoff.
+        dead = "127.0.0.1:1"
+        sender.send(dead, b"void")
+        for _ in range(200):
+            g = reg.gauges.get(
+                f"net.reliable.peer.consecutive_failures.{dead}"
+            )
+            if g is not None and g.value >= 2:
+                break
+            await asyncio.sleep(0.05)
+        sender.close()
+        await recv.shutdown()
+        return addr, dead
+
+    addr, dead = asyncio.run(asyncio.wait_for(go(), 15))
+    snap = metrics.registry().snapshot()
+    rtt = snap["histograms"][f"net.reliable.peer.rtt_seconds.{addr}"]
+    assert rtt["count"] == 1 and rtt["sum"] > 0
+    assert (
+        snap["gauges"][f"net.reliable.peer.consecutive_failures.{dead}"] >= 2
+    )
+    assert snap["gauges"][f"net.reliable.peer.backing_off.{dead}"] == 1
+    # The live peer's failure gauge ended at zero (successful connect).
+    assert (
+        snap["gauges"][f"net.reliable.peer.consecutive_failures.{addr}"] == 0
+    )
+    # Prometheus rendering mangles the address into a legal metric name.
+    prom = metrics.registry().render_prometheus()
+    assert f"net_reliable_peer_rtt_seconds_{addr}".replace(
+        ".", "_"
+    ).replace(":", "_") in prom
+
+
+# -- scraper ------------------------------------------------------------------
+
+class _ServerThread:
+    """Host a MetricsServer on its own asyncio loop in a daemon thread so
+    the synchronous Scraper can poll it like a real node."""
+
+    def __init__(self, reg):
+        self.reg = reg
+        self.port = None
+        self._started = threading.Event()
+        self._stop = None
+        self._loop = None
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        assert self._started.wait(10), "metrics server thread never started"
+
+    def _run(self):
+        asyncio.run(self._main())
+
+    async def _main(self):
+        self._loop = asyncio.get_running_loop()
+        self._stop = asyncio.Event()
+        server = await MetricsServer.spawn(self.reg, 0, host="127.0.0.1")
+        self.port = server.port
+        self._started.set()
+        await self._stop.wait()
+        await server.shutdown()
+
+    def stop(self):
+        self._loop.call_soon_threadsafe(self._stop.set)
+        self._thread.join(timeout=10)
+
+
+def test_scraper_against_canned_server():
+    from benchmark.metrics_check import build_timeline
+    from benchmark.scraper import Scraper
+
+    reg = Registry()
+    commits = reg.counter("consensus.committed_certificates")
+    reg.gauge("primary.round").set(4)
+    reg.trace.mark("d1", "seal")  # trace must NOT ride along (?trace=0)
+    mon = HealthMonitor(reg, rules=default_rules(), interval_s=1.0)
+    reg.health = mon
+    mon.evaluate()
+
+    srv = _ServerThread(reg)
+    try:
+        scraper = Scraper(
+            [
+                ("node-0", "127.0.0.1", srv.port),
+                ("node-gone", "127.0.0.1", 1),  # unreachable: skipped
+            ],
+            interval_s=0.05,
+        )
+        assert scraper.sample_once() == 1
+        commits.inc(10)
+        assert scraper.sample_once() == 1
+        assert scraper.commits_observed() == 10
+
+        healthz = scraper.healthz_all()
+        assert healthz["node-0"][0] == 200
+        assert healthz["node-gone"][0] is None
+
+        timeline = build_timeline(
+            scraper.samples, interval_s=0.05, healthz=healthz
+        )
+        series = timeline["nodes"]["node-0"]
+        assert len(series) == 2
+        assert series[0]["commits"] == 0 and series[1]["commits"] == 10
+        assert series[1]["commit_rate_per_s"] > 0
+        assert series[1]["round"] == 4
+        assert series[1]["health_firing"] == 0
+        assert timeline["healthz"]["node-0"]["status"] == 200
+        assert timeline["healthz"]["node-gone"]["status"] is None
+        # ?trace=0 kept the heavyweight table out of every sample.
+        assert all("trace" not in s for s in scraper.samples)
+    finally:
+        srv.stop()
+
+
+def test_scraper_start_stop_collects_over_time():
+    from benchmark.scraper import Scraper
+
+    reg = Registry()
+    c = reg.counter("consensus.committed_certificates")
+    srv = _ServerThread(reg)
+    try:
+        scraper = Scraper(
+            [("n0", "127.0.0.1", srv.port)], interval_s=0.05
+        ).start()
+        import time as _time
+
+        deadline = _time.time() + 5
+        while len(scraper.samples) < 3 and _time.time() < deadline:
+            c.inc()
+            _time.sleep(0.02)
+        scraper.stop()
+        assert len(scraper.samples) >= 3
+        assert all(s["node"] == "n0" for s in scraper.samples)
+    finally:
+        srv.stop()

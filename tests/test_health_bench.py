@@ -1,0 +1,125 @@
+"""Tier-1 clean-run health test: a healthy 4-node local_bench run must end
+with ZERO firing health rules (no false positives — an alert layer that
+cries wolf on a clean committee is worse than none) and a populated live
+timeline: every node process scraped at least 3 times during the window,
+and a per-peer RTT matrix naming each primary's three peers.
+
+This is the false-positive half of the acceptance pair with
+tests/test_health_failover.py (the true-positive half), and the first
+test to drive benchmark/local_bench.py end to end under pytest."""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmark.local_bench import run_bench  # noqa: E402
+
+
+def _run_clean_bench(tmp_path):
+    """Same shared-core retry convention as tests/test_remote_bench.py:
+    a fixed-duration measurement window on a loaded host can starve the
+    whole committee — a host artifact, retried once with the scraped
+    time-series dumped for diagnosis.  A genuine regression fails both
+    attempts."""
+    for attempt in (1, 2):
+        result = run_bench(
+            nodes=4,
+            workers=1,
+            rate=2_000,
+            tx_size=512,
+            duration=8,
+            base_port=7600,
+            workdir=str(tmp_path / f"bench-{attempt}"),
+            quiet=True,
+            scrape_interval=1.0,
+            # Widen the window on wall-clock payload-commit progress: on
+            # a starved core the clients can ramp so late that a fixed
+            # 8 s window closes before the first client batch commits.
+            progress_wait=30,
+        )
+        ok = (
+            result.errors == []
+            and result.committed_batches > 0
+            # Every node answered the quiesce /healthz round: a node the
+            # probe couldn't reach (status None, a starved-host artifact
+            # the harness gate deliberately ignores) fails THIS test's
+            # strict assertions below, so burn the retry on it.
+            and all(
+                v["status"] == 200
+                for v in (result.timeline.get("healthz") or {}).values()
+            )
+        )
+        if ok or attempt == 2:
+            return result
+        print(
+            f"window {attempt} failed (errors={result.errors!r}); "
+            "scraped timeline dump:",
+            file=sys.stderr,
+        )
+        for node, series in sorted(
+            (result.timeline.get("nodes") or {}).items()
+        ):
+            last = series[-1] if series else {}
+            print(
+                f"  {node}: {len(series)} samples, last={json.dumps(last)}",
+                file=sys.stderr,
+            )
+
+
+def test_clean_local_bench_has_timeline_and_no_firing_rules(tmp_path):
+    result = _run_clean_bench(tmp_path)
+
+    # CI artifact: the committee timeline from the bench run, uploaded
+    # by the workflow (same NARWHAL_METRICS_DUMP convention as the
+    # metrics-smoke snapshot).
+    dump_dir = os.environ.get("NARWHAL_METRICS_DUMP")
+    if dump_dir:
+        os.makedirs(dump_dir, exist_ok=True)
+        with open(os.path.join(dump_dir, "timeline.json"), "w") as f:
+            json.dump(result.timeline, f, indent=1)
+
+    # The run itself is clean: parses, commits, cross-validates, and —
+    # new gate — no node's /healthz reported a firing rule at quiesce
+    # (check_quiesce_health would have appended an error).
+    assert result.errors == []
+    assert result.committed_batches > 0
+
+    timeline = result.timeline
+    nodes = timeline["nodes"]
+    # All 8 processes (4 primaries + 4 workers) were scraped, ≥3 samples
+    # each over the 8 s window at 1 Hz.
+    expected = {f"primary-{i}" for i in range(4)} | {
+        f"worker-{i}-0" for i in range(4)
+    }
+    assert set(nodes) == expected, f"scraped: {sorted(nodes)}"
+    for name, series in nodes.items():
+        assert len(series) >= 3, f"{name}: only {len(series)} samples"
+        # No sample ever saw a firing rule on a clean run.
+        assert all(p["health_firing"] == 0 for p in series), (
+            name,
+            [p for p in series if p["health_firing"]],
+        )
+    # Primaries show commit progress over time (the live channel the
+    # post-mortem snapshots cannot provide).
+    for i in range(4):
+        series = nodes[f"primary-{i}"]
+        assert series[-1]["commits"] > 0
+        assert series[-1]["round"] > 2
+
+    # Per-peer RTT matrix: each primary exchanged ACKed frames with its
+    # three peers, each with a positive mean RTT.
+    rtt = timeline["rtt_ms"]
+    for i in range(4):
+        peers = rtt.get(f"primary-{i}", {})
+        assert len(peers) >= 3, f"primary-{i} RTT peers: {sorted(peers)}"
+        for peer, stats in peers.items():
+            assert stats["count"] > 0 and stats["mean_ms"] > 0
+
+    # Every node answered the quiesce /healthz round with 200.
+    healthz = timeline["healthz"]
+    assert set(healthz) == expected
+    for name, verdict in healthz.items():
+        assert verdict["status"] == 200, (name, verdict)
+        assert verdict["firing"] == [], (name, verdict)

@@ -244,6 +244,72 @@ def test_stage_names_match_metrics_check():
     assert tuple(STAGE_ORDER) == metrics.STAGES
 
 
+def test_trace_eviction_counter_exported_and_warned(capsys):
+    """Evictions past NARWHAL_TRACE_CAP must be counted, exported in the
+    snapshot (gauges["metrics.trace_evictions"]), and surfaced by the
+    bench cross-check as a loud UNDER-JOINED warning + stages_ms
+    annotation — never a silently biased breakdown (ROADMAP item)."""
+    from benchmark.logs import ParseResult
+    from benchmark.metrics_check import cross_validate
+
+    reg = Registry(trace_cap=2)
+    reg.trace.mark("d1", "seal", ts=1.0)
+    reg.trace.mark("d2", "seal", ts=2.0)
+    assert reg.trace.evictions == 0
+    reg.trace.mark("d3", "seal", ts=3.0)  # evicts d1
+    reg.trace.mark("d4", "seal", ts=4.0)  # evicts d2
+    assert reg.trace.evictions == 2
+    snap = reg.snapshot()
+    assert snap["gauges"]["metrics.trace_evictions"] == 2
+    assert "d1" not in snap["trace"] and "d4" in snap["trace"]
+
+    r = ParseResult(committed_bytes=0)
+    summary = cross_validate(r, [snap], tx_size=512)
+    assert summary["trace_evictions"] == 2
+    assert r.stages_ms["trace_evictions"] == 2.0
+    assert "UNDER-JOINED" in capsys.readouterr().err
+
+    # reset() zeroes the eviction count with everything else.
+    reg.reset()
+    assert reg.trace.evictions == 0
+
+
+def test_json_log_formatter_machine_joinable():
+    """--log-json records: one JSON object per line with ts (unix
+    epoch), level, logger, msg, node — joinable against the metrics
+    time-series without timestamp re-parsing."""
+    import logging
+    import time
+
+    from narwhal_tpu.node.main import JsonLogFormatter
+
+    fmt = JsonLogFormatter("primary-AbCd1234")
+    record = logging.LogRecord(
+        "narwhal.metrics", logging.WARNING, __file__, 1,
+        "HEALTH anomaly %s rule=%s", ("FIRING", "peer_unreachable"), None,
+    )
+    line = fmt.format(record)
+    entry = json.loads(line)
+    assert "\n" not in line
+    assert entry["level"] == "WARNING"
+    assert entry["logger"] == "narwhal.metrics"
+    assert entry["msg"] == "HEALTH anomaly FIRING rule=peer_unreachable"
+    assert entry["node"] == "primary-AbCd1234"
+    assert abs(entry["ts"] - time.time()) < 60
+
+    try:
+        raise ValueError("boom")
+    except ValueError:
+        import sys as _sys
+
+        rec2 = logging.LogRecord(
+            "narwhal.node", logging.ERROR, __file__, 1, "died", (),
+            _sys.exc_info(),
+        )
+    entry2 = json.loads(fmt.format(rec2))
+    assert "ValueError: boom" in entry2["exc"]
+
+
 def test_cross_validate_agreement_and_failure():
     """The bench cross-check passes on agreeing channels, hard-fails
     (error entry) past the 5% tolerance, and emits the stage breakdown."""

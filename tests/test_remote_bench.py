@@ -3,6 +3,7 @@ LocalRunner "hosts" (separate workdirs, full TCP mesh between them) must
 boot, commit, and parse cleanly through the same path an SSH deployment
 uses (benchmark/remote_bench.py; reference remote.py:139-311)."""
 
+import json
 import os
 import sys
 
@@ -11,15 +12,43 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from benchmark.remote_bench import run_remote_bench  # noqa: E402
 
 
+def _dump_scrape_diagnostics(result):
+    """On a failed window, print what the live scrape actually saw —
+    which nodes answered, how far their rounds/commits got — so a flake
+    is diagnosable from the test log instead of needing a re-run."""
+    timeline = getattr(result, "timeline", {}) or {}
+    print("scraped-metrics diagnostic dump:", file=sys.stderr)
+    for node, series in sorted(timeline.get("nodes", {}).items()):
+        last = series[-1] if series else {}
+        print(
+            f"  {node}: {len(series)} samples, last="
+            + json.dumps(
+                {
+                    k: last.get(k)
+                    for k in ("round", "commits", "txs_sealed",
+                              "health_firing")
+                }
+            ),
+            file=sys.stderr,
+        )
+    for node, verdict in sorted((timeline.get("healthz") or {}).items()):
+        print(f"  healthz {node}: {verdict}", file=sys.stderr)
+
+
 def _run_committee(tmp_path, **kwargs):
-    """One retry on a failed window: these are fixed-duration measurement
-    runs (boot → commit for N seconds → parse), and on a shared single
-    core a background CPU spike during the window can starve the whole
+    """One retry on a failed window: these are measurement runs (boot →
+    commit for N seconds → parse), and on a shared single core a
+    background CPU spike during the window can starve the whole
     committee past its deadlines — a host artifact, not a protocol bug
     (the protocol-level e2e tests in test_e2e.py poll with generous
-    deadlines instead and don't need this).  A genuine regression fails
-    both attempts."""
+    deadlines instead and don't need this).  Two layers of defense:
+    the window itself widens on wall-clock progress checks over the
+    scraped metrics (progress_wait — no commits seen yet means the
+    window isn't a measurement at all), and a zero-commit attempt is
+    retried once with the scraped time-series dumped as diagnostics.
+    A genuine regression fails both attempts."""
     hosts = [f"{tmp_path}/h0", f"{tmp_path}/h1"]
+    kwargs.setdefault("progress_wait", 30)
     for attempt in (1, 2):
         result = run_remote_bench(
             [f"local:{h}" for h in hosts], quiet=True, **kwargs
@@ -36,6 +65,7 @@ def _run_committee(tmp_path, **kwargs):
             f"committed={result.committed_batches}); retrying",
             file=sys.stderr,
         )
+        _dump_scrape_diagnostics(result)
 
 
 def test_two_host_committee_commits(tmp_path):
@@ -52,6 +82,12 @@ def test_two_host_committee_commits(tmp_path):
     assert result.committed_batches > 0
     assert result.consensus_tps > 0
     assert result.samples > 0  # client→batch→commit join worked end-to-end
+    # The remote harness now scrapes every node's --metrics-port during
+    # the run: the committee timeline must have real samples and no node
+    # may end the window with a firing health rule.
+    assert result.timeline["nodes"], "remote scrape collected no samples"
+    for node, verdict in result.timeline["healthz"].items():
+        assert verdict["status"] in (200, None), (node, verdict)
 
 
 def test_non_collocated_placement_commits(tmp_path):
