@@ -22,6 +22,15 @@ N ∈ {4, 20, 50} and a gc_depth-50 window:
   W-bool committed-bitmap fetch.  The per-iteration re-staging makes the
   kernel number an honest STEADY-STATE cost, not an empty-pending fast
   path.
+- **commit burst** (PR 4) — a full multi-leader commit: odd rounds
+  delivered first so nothing commits until one trigger certificate
+  flattens the ENTIRE chain in a single `process_certificate` call.
+  Three arms over identical streams: the frozen r06 dict walk
+  (`consensus/golden.py`, the equivalence oracle), the live indexed walk
+  (`consensus/tusk.py` — digest-index parent resolution, incremental
+  support, one GC sweep per burst), and the device kernel (whose burst
+  pays the catch-up window flush).  The acceptance gate (ISSUE r09) is
+  indexed ≥ 2× the dict walk at N ≥ 20 over a 50-round DAG.
 
 Floor honesty: every kernel commit pays one device round trip for the
 bitmap fetch.  On a tunneled/remote chip that fetch floor (~69 ms
@@ -54,6 +63,7 @@ from narwhal_tpu.config import (  # noqa: E402
     WorkerAddresses,
 )
 from narwhal_tpu.crypto import KeyPair  # noqa: E402
+from narwhal_tpu.consensus.golden import GoldenTusk  # noqa: E402
 from narwhal_tpu.consensus.tusk import Tusk  # noqa: E402
 from narwhal_tpu.primary.messages import Certificate, Header, genesis  # noqa: E402
 
@@ -188,6 +198,91 @@ def bench_pair(kernel_cls, committee, span, iters, build_reps):
     }
 
 
+def make_burst_certs(committee: Committee, rounds: int):
+    """A multi-leader commit-burst stream: odd rounds delivered before
+    even rounds, so NO arrival can trigger a commit (odd-round arrivals
+    find no even-round leader yet; even-round arrivals never run the
+    commit check) — until one final trigger certificate commits the
+    ENTIRE chain of linked leaders in a single process_certificate call.
+    This is the worst case for the golden walk's per-certificate
+    ``State.update`` full sweep (quadratic in burst size) and the shape
+    the indexed walk's batched sweep targets."""
+    names = sorted(committee.authorities.keys())
+    parents = {c.digest() for c in genesis(committee)}
+    certs = []
+    for r in range(1, rounds + 1):
+        nxt = set()
+        for name in names:
+            cert = mock_certificate(name, r, parents)
+            certs.append(cert)
+            nxt.add(cert.digest())
+        parents = nxt
+    order = sorted(certs, key=lambda c: (c.round % 2 == 0, c.round))
+    trigger = mock_certificate(names[0], rounds + 1, parents)
+    return order, trigger
+
+
+def bench_commit_burst(
+    kernel_cls, committee: Committee, rounds: int, iters: int, floor_s: float
+):
+    """One multi-leader burst commit, measured per implementation arm:
+    the frozen r06 dict walk (GoldenTusk — the oracle), the indexed walk
+    (Tusk), and the device kernel.  State is rebuilt per iteration (the
+    burst consumes it); only the trigger call is timed.  Arms interleave
+    inside each iteration so shared-core scheduling noise hits all three
+    equally (same rationale as bench_pair).  Returns median seconds per
+    arm plus the burst size; asserts all arms commit byte-identical
+    sequences."""
+    order, trigger = make_burst_certs(committee, rounds)
+    gc_depth = rounds + 4
+    arms = [("dict_walk", GoldenTusk), ("indexed", Tusk)]
+    if kernel_cls is not None:
+        arms.append(("kernel", kernel_cls))
+    times = {name: [] for name, _ in arms}
+    chains = {}
+    for rep in range(max(1, iters)):
+        plan = list(arms)
+        if rep % 2:  # alternate order to cancel slow-window drift
+            plan.reverse()
+        for name, cls in plan:
+            tusk = cls(committee, gc_depth=gc_depth, fixed_coin=True)
+            for cert in order:
+                tusk.process_certificate(cert)
+            t0 = time.perf_counter()
+            seq = tusk.process_certificate(trigger)
+            times[name].append(time.perf_counter() - t0)
+            chains[name] = [bytes(x.digest()) for x in seq]
+    want = chains["dict_walk"]
+    assert want, "burst fixture committed nothing"
+    for name, chain in chains.items():
+        assert chain == want, (
+            f"commit-burst sequences diverge: {name} emitted "
+            f"{len(chain)} certs vs dict_walk {len(want)}"
+        )
+    out = {
+        "burst_rounds": rounds,
+        "burst_committed_certs": len(want),
+        "dict_walk_ms": round(
+            statistics.median(times["dict_walk"]) * 1e3, 3
+        ),
+        "indexed_ms": round(statistics.median(times["indexed"]) * 1e3, 3),
+    }
+    out["indexed_speedup_vs_dict"] = round(
+        statistics.median(times["dict_walk"])
+        / statistics.median(times["indexed"]),
+        2,
+    )
+    if kernel_cls is not None:
+        ke = statistics.median(times["kernel"])
+        out["kernel_ms"] = round(ke * 1e3, 3)
+        # Floor honesty, same policy as the steady-state commit phase:
+        # the kernel burst pays one committed-bitmap fetch.
+        out["kernel_ms_floor_subtracted"] = round(
+            max(ke - floor_s, 0.0) * 1e3, 3
+        )
+    return out
+
+
 def measure_fetch_floor():
     """Fixed device round-trip floor on this host: median wall time of a
     trivial jitted compute + result fetch.  On a tunneled/remote chip this
@@ -214,8 +309,20 @@ def main() -> None:
     ap.add_argument("--span", type=int, default=48)
     ap.add_argument("--iters", type=int, default=9)
     ap.add_argument("--build-reps", type=int, default=3)
+    ap.add_argument(
+        "--burst-rounds",
+        type=int,
+        default=50,
+        help="Rounds in the multi-leader commit-burst phase (odd rounds "
+        "delivered first; one trigger commits the whole chain).  Must be "
+        "even — the trigger at rounds+1 only fires the commit rule from "
+        "an odd round; odd values are rounded up.",
+    )
+    ap.add_argument("--burst-iters", type=int, default=5)
     ap.add_argument("--artifact", type=str, default=None)
     args = ap.parse_args()
+    if args.burst_rounds % 2:
+        args.burst_rounds += 1  # see --burst-rounds help: must be even
 
     import jax
 
@@ -228,6 +335,10 @@ def main() -> None:
     results = []
     for n in args.sizes:
         committee = make_committee(n)
+        burst = bench_commit_burst(
+            KernelTusk, committee, args.burst_rounds, args.burst_iters,
+            floor_s,
+        )
         pair = bench_pair(
             KernelTusk, committee, args.span, args.iters, args.build_reps
         )
@@ -266,6 +377,10 @@ def main() -> None:
             "insert_overhead_pct": round(
                 (ke["insert_s"] / py["insert_s"] - 1) * 100, 1
             ),
+            # Multi-leader commit burst (PR 4): r06 dict walk vs the
+            # indexed walk (vs the kernel's catch-up flush) on one
+            # trigger committing the whole chain.
+            "commit_burst": burst,
         }
         results.append(row)
         print(json.dumps(row))
@@ -283,6 +398,13 @@ def main() -> None:
         "kernel_insert_not_worse_than_python": all(
             r["kernel_insert_ms"] <= r["python_insert_ms"]
             for r in results
+        ),
+        # PR 4 gate: the indexed walk at least doubles the dict walk on
+        # the multi-leader burst at committee sizes ≥ 20.
+        "indexed_burst_speedup_ge2_at_n_ge_20": all(
+            r["commit_burst"]["indexed_speedup_vs_dict"] >= 2
+            for r in results
+            if r["committee"] >= 20
         ),
     }
     print(json.dumps({"acceptance": acceptance}))

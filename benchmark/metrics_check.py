@@ -128,15 +128,21 @@ def cross_validate(
 
     # Per-stage latency breakdown over digests carrying the full chain
     # (own-batch traces: sealed, quorum'd, proposed, certified at the
-    # same authority, commit joined committee-wide).
+    # same authority, commit joined committee-wide).  cert→commit is now
+    # subdivided (cert_inserted / commit_trigger / walk_done sub-stages),
+    # but the aggregate leg stays in the output: it is the number every
+    # prior artifact tracks (metrics_stage_breakdown_r07.json) and the
+    # one the r09 acceptance gate compares.
     legs: Dict[str, List[float]] = {
         f"{a}_to_{b}": [] for a, b in STAGE_LEGS
     }
+    cert_commit: List[float] = []
     totals: List[float] = []
     for st in stage_ts.values():
         if all(s in st for s in STAGE_ORDER):
             for a, b in STAGE_LEGS:
                 legs[f"{a}_to_{b}"].append(st[b] - st[a])
+            cert_commit.append(st["commit"] - st["cert"])
             totals.append(st["commit"] - st["seal"])
     if totals:
         result.stages_ms = {
@@ -144,6 +150,9 @@ def cross_validate(
             for name, v in legs.items()
             if v
         }
+        result.stages_ms["cert_to_commit"] = round(
+            1000 * sum(cert_commit) / len(cert_commit), 2
+        )
         result.stages_ms["seal_to_commit"] = round(
             1000 * sum(totals) / len(totals), 2
         )
@@ -181,6 +190,8 @@ def build_timeline(
                            "committed_batches", "txs_sealed",
                            "pending_acks", "health_firing",
                            "commit_rate_per_s", "txs_sealed_per_s"}, …]},
+         "events": [{"node", "t", "event": "FIRING"|"cleared", "rule",
+                     "subject", "detail"}, …],   # anomaly transitions
          "rtt_ms": {name: {peer_addr: {"mean_ms", "count"}}},
          "healthz": {name: {"status": code|None, "firing": [rule names]}}}
 
@@ -189,10 +200,45 @@ def build_timeline(
     post-mortem snapshot can structurally never show.  The RTT matrix
     comes from each node's LAST sample (per-peer histograms are
     cumulative, so last = whole-run mean).
+
+    The ``events`` track is the HealthMonitor's FIRING/cleared
+    transitions promoted to a first-class, committee-wide list: each
+    node's snapshots carry a bounded ``health.events`` ring, and the
+    scraper sees it grow tick by tick — deduplicated here by (node,
+    rule, subject, event, t) since the ring is cumulative across
+    samples, merged with the quiesce /healthz bodies (which can carry
+    transitions after the last scrape tick), and sorted by time so rule
+    firings line up against the per-node rate series they explain.
     """
     by_node: Dict[str, List[dict]] = {}
     for s in sorted(samples, key=lambda s: s.get("t", 0.0)):
         by_node.setdefault(s["node"], []).append(s)
+
+    events: List[dict] = []
+    seen_events = set()
+
+    def collect_events(name: str, health: Optional[dict]) -> None:
+        for ev in (health or {}).get("events") or []:
+            key = (
+                name,
+                ev.get("rule"),
+                ev.get("subject"),
+                ev.get("event"),
+                ev.get("t"),
+            )
+            if key in seen_events:
+                continue
+            seen_events.add(key)
+            events.append(
+                {
+                    "node": name,
+                    "t": ev.get("t"),
+                    "event": ev.get("event"),
+                    "rule": ev.get("rule"),
+                    "subject": ev.get("subject"),
+                    "detail": ev.get("detail") or {},
+                }
+            )
 
     nodes: Dict[str, List[dict]] = {}
     rtt_ms: Dict[str, Dict[str, dict]] = {}
@@ -202,6 +248,7 @@ def build_timeline(
         for s in node_samples:
             counters, gauges = s["counters"], s["gauges"]
             health = s.get("health") or {}
+            collect_events(name, health)
             point = {
                 "t": round(s["t"], 3),
                 "round": gauges.get("primary.round"),
@@ -241,7 +288,18 @@ def build_timeline(
         if peers:
             rtt_ms[name] = peers
 
-    out = {"interval_s": interval_s, "nodes": nodes, "rtt_ms": rtt_ms}
+    if healthz is not None:
+        # Transitions between the last scrape tick and quiesce ride in
+        # the /healthz bodies' events ring.
+        for name, (_, body) in healthz.items():
+            collect_events(name, body)
+    events.sort(key=lambda ev: (ev["t"] is None, ev["t"] or 0.0))
+    out = {
+        "interval_s": interval_s,
+        "nodes": nodes,
+        "events": events,
+        "rtt_ms": rtt_ms,
+    }
     if healthz is not None:
         out["healthz"] = {
             name: {

@@ -8,9 +8,34 @@ No extra messages: the commit rule is a pure function of the DAG.
 
 The pure state machine (`Tusk.process_certificate`) is separated from the
 async runner (`Consensus`) so the commit rule can be golden-tested directly
-and later swapped for the JAX adjacency-matrix kernel
+and swapped for the JAX adjacency-matrix kernel
 (narwhal_tpu/ops/reachability.py) validated certificate-for-certificate
 against this implementation.
+
+Commit-path latency model (PR 4 rebuild — the r07 stage breakdown measured
+cert→commit at 77% of seal→commit end-to-end latency, and Mysticeti's core
+argument is that DAG-consensus latency is won or lost in the commit rule's
+reaction time):
+
+- a digest → certificate index rides alongside the round → origin DAG, so
+  ``order_dag`` parent resolution and ``linked()`` reachability are O(1)
+  per edge instead of a linear scan over a round's certificates per hop;
+- leader support accumulates INCREMENTALLY at insert time (a round-(r+1)
+  certificate bumps its round-r leader's support counter once), so the
+  f+1 gate in ``process_certificate`` is a dict read, not a rescan of the
+  whole child round on every odd-round arrival;
+- committing updates the frontier per certificate (O(1)) but sweeps the
+  DAG window for garbage exactly ONCE per commit burst (``State.gc``) —
+  the old per-certificate ``State.update`` full sweep was quadratic in
+  burst size;
+- the async runner drains its input queue in bursts, processing a backlog
+  of queued certificates per wakeup instead of one per task switch.
+
+Every rewrite above is certificate-for-certificate equivalent to the r06
+dict walk, which is kept frozen as the oracle in
+``narwhal_tpu/consensus/golden.py`` and diffed against on recorded
+multi-leader / gc-wrap / checkpoint-restore streams
+(tests/test_tusk_equivalence.py).
 """
 
 from __future__ import annotations
@@ -19,6 +44,7 @@ import asyncio
 import logging
 import os
 import struct
+import time
 from typing import Dict, List, Optional, Tuple
 
 from .. import metrics
@@ -34,7 +60,15 @@ Dag = Dict[Round, Dict[PublicKey, Tuple[Digest, Certificate]]]
 
 
 class State:
-    """Consensus state (reference lib.rs:19-62)."""
+    """Consensus state (reference lib.rs:19-62), indexed.
+
+    Alongside the reference's round-keyed DAG this keeps
+    ``digest_index``: digest → certificate for every certificate currently
+    in the DAG (genesis included).  The index is maintained by
+    :meth:`insert` and pruned by :meth:`gc`, so membership in the index is
+    exactly membership in the DAG — ``order_dag``/``linked`` resolve
+    parent digests in O(1) instead of scanning a round dict per lookup.
+    """
 
     def __init__(self, genesis_certs: List[Certificate]) -> None:
         gen = {c.origin: (c.digest(), c) for c in genesis_certs}
@@ -43,6 +77,9 @@ class State:
             name: cert.round for name, (_, cert) in gen.items()
         }
         self.dag: Dag = {0: gen}
+        self.digest_index: Dict[Digest, Certificate] = {
+            d: cert for (d, cert) in gen.values()
+        }
 
     _CKPT_MAGIC = b"NCKPT1"
 
@@ -88,22 +125,68 @@ class State:
         for name, round in entries:
             self.last_committed[name] = round
 
-    def update(self, certificate: Certificate, gc_depth: Round) -> None:
-        """Record a commit and garbage-collect the DAG window."""
-        origin = certificate.origin
-        self.last_committed[origin] = max(
-            self.last_committed.get(origin, 0), certificate.round
-        )
-        self.last_committed_round = max(self.last_committed.values())
-        last = self.last_committed_round
-        for name, round in self.last_committed.items():
-            for r in list(self.dag):
-                authorities = self.dag[r]
-                if name in authorities and r < round:
-                    del authorities[name]
-                if not authorities or r + gc_depth < last:
-                    del self.dag[r]
+    def insert(
+        self, certificate: Certificate
+    ) -> Tuple[Digest, Optional[Digest]]:
+        """Insert into the DAG and digest index.  Returns
+        ``(digest, prev_digest)`` where ``prev_digest`` is the digest this
+        (round, origin) slot previously held: the same digest for an
+        idempotent re-insert (nothing changed), a different digest for an
+        equivocation overwrite, or None for a fresh slot — the caller
+        (Tusk) uses the distinction to keep its incremental support
+        counters exact."""
+        d = certificate.digest()
+        slot = self.dag.setdefault(certificate.round, {})
+        prev = slot.get(certificate.origin)
+        if prev is not None and prev[0] == d:
+            return d, d
+        slot[certificate.origin] = (d, certificate)
+        self.digest_index[d] = certificate
+        if prev is not None:
+            self.digest_index.pop(prev[0], None)
+            return d, prev[0]
+        return d, None
 
+    def note_committed(self, certificate: Certificate) -> None:
+        """O(1) frontier advance for one committed certificate.  The DAG
+        sweep is deferred to ONE :meth:`gc` call per commit burst — the
+        golden walk's per-certificate full sweep (golden.py
+        ``GoldenState.update``) made a K-certificate burst cost K full
+        window scans."""
+        origin = certificate.origin
+        if certificate.round > self.last_committed.get(origin, 0):
+            self.last_committed[origin] = certificate.round
+        if certificate.round > self.last_committed_round:
+            self.last_committed_round = certificate.round
+
+    def gc(self, gc_depth: Round) -> None:
+        """One garbage sweep over the window: drop per-authority entries
+        strictly below that authority's committed round, whole rounds
+        beyond the gc horizon, and empty rounds — pruning the digest
+        index in lockstep so index membership stays exactly DAG
+        membership.  End-state identical to the golden per-certificate
+        sweep (the deferred deletions are all entries the order_dag ≥
+        skip already excludes — tests/test_tusk_equivalence.py)."""
+        last = self.last_committed_round
+        index = self.digest_index
+        last_committed = self.last_committed
+        for r in list(self.dag):
+            authorities = self.dag[r]
+            if r + gc_depth < last:
+                for d, _ in authorities.values():
+                    index.pop(d, None)
+                del self.dag[r]
+                continue
+            dead = [
+                name
+                for name in authorities
+                if r < last_committed.get(name, 0)
+            ]
+            for name in dead:
+                index.pop(authorities[name][0], None)
+                del authorities[name]
+            if not authorities:
+                del self.dag[r]
 
 class Tusk:
     """The pure commit rule: feed certificates, get ordered commit batches."""
@@ -118,21 +201,78 @@ class Tusk:
         self.fixed_coin = fixed_coin
         self.state = State(genesis(committee))
         self._sorted_keys = sorted(committee.authorities.keys())
+        # Incremental f+1 support: even leader round → accumulated stake of
+        # round+1 certificates citing the leader's digest.  Maintained by
+        # insert_certificate; equal at every query point to the golden
+        # walk's from-scratch rescan of the child round (the rare
+        # equivocation-overwrite path recomputes instead of patching).
+        self._support: Dict[Round, int] = {}
 
     def leader(self, round: Round, dag: Dag) -> Optional[Tuple[Digest, Certificate]]:
         """Round-robin leader (a common coin in the full protocol —
         reference lib.rs:205-221)."""
-        coin = 0 if self.fixed_coin else round
-        name = self._sorted_keys[coin % len(self._sorted_keys)]
-        return dag.get(round, {}).get(name)
+        return dag.get(round, {}).get(self._leader_name(round))
+
+    def _leader_name(self, round_: Round) -> PublicKey:
+        coin = 0 if self.fixed_coin else round_
+        return self._sorted_keys[coin % len(self._sorted_keys)]
 
     def insert_certificate(self, certificate: Certificate) -> None:
         """Insert into the DAG without running the commit rule.  Separate
         seam so KernelTusk can maintain its dense device window
-        incrementally, and benchmarks can build large DAG states."""
-        self.state.dag.setdefault(certificate.round, {})[
-            certificate.origin
-        ] = (certificate.digest(), certificate)
+        incrementally, and benchmarks can build large DAG states.  Also
+        the single maintenance point for the digest index (via
+        State.insert) and the incremental leader-support counters."""
+        d, prev = self.state.insert(certificate)
+        if prev is not None and prev == d:
+            return  # idempotent re-insert: counters already reflect it
+        r = certificate.round
+        if prev is None:
+            # Fresh slot: incremental support accounting.
+            if r % 2 == 1 and r >= 3:
+                # This certificate may support the leader of round r-1.
+                got = self.leader(r - 1, self.state.dag)
+                if got is not None and got[0] in certificate.header.parents:
+                    self._support[r - 1] = self._support.get(
+                        r - 1, 0
+                    ) + self.committee.stake(certificate.origin)
+            elif (
+                r % 2 == 0
+                and r >= 2
+                and certificate.origin == self._leader_name(r)
+            ):
+                # The leader itself arrived (possibly after some of its
+                # supporters): seed its counter from the children already
+                # present — one O(N) scan per leader insert, not per
+                # arrival.
+                self._recompute_support(r)
+        else:
+            # Equivocation overwrite (same slot, different digest): the
+            # old certificate's contributions are baked into the counters.
+            # Rare and adversarial — recompute the affected round exactly.
+            if r % 2 == 1 and r >= 3:
+                self._recompute_support(r - 1)
+            elif (
+                r % 2 == 0
+                and r >= 2
+                and certificate.origin == self._leader_name(r)
+            ):
+                self._recompute_support(r)
+
+    def _recompute_support(self, leader_round: Round) -> None:
+        """From-scratch support for one leader round (the golden rescan,
+        used only on the cold paths: leader arriving after supporters, or
+        an equivocation overwrite)."""
+        got = self.leader(leader_round, self.state.dag)
+        if got is None:
+            self._support.pop(leader_round, None)
+            return
+        leader_digest = got[0]
+        self._support[leader_round] = sum(
+            self.committee.stake(cert.origin)
+            for _, cert in self.state.dag.get(leader_round + 1, {}).values()
+            if leader_digest in cert.header.parents
+        )
 
     def process_certificate(self, certificate: Certificate) -> List[Certificate]:
         """Insert a certificate; return the newly committed sequence
@@ -152,64 +292,95 @@ class Tusk:
         got = self.leader(leader_round, state.dag)
         if got is None:
             return []
-        leader_digest, leader = got
+        _, leader = got
 
-        # f+1 support among the children (round r-1 certificates).
-        stake = sum(
-            self.committee.stake(cert.origin)
-            for _, cert in state.dag.get(r - 1, {}).values()
-            if leader_digest in cert.header.parents
-        )
-        if stake < self.committee.validity_threshold():
+        # f+1 support among the children (round r-1 certificates) — an
+        # O(1) read of the incrementally-accumulated counter.
+        if self._support.get(leader_round, 0) < self.committee.validity_threshold():
             log.debug("Leader %r does not have enough support", leader)
             return []
 
         # Commit every linked uncommitted leader, oldest first, each
-        # flattening its causal sub-DAG.
+        # flattening its causal sub-DAG.  The frontier advances per
+        # certificate (order_dag's skip must see it), but the garbage
+        # sweep runs ONCE for the whole burst.
         log.debug("Leader %r has enough support", leader)
         sequence: List[Certificate] = []
         for past_leader in reversed(self.order_leaders(leader)):
             for x in self.order_dag(past_leader):
-                state.update(x, self.gc_depth)
+                state.note_committed(x)
                 sequence.append(x)
+        if sequence:
+            state.gc(self.gc_depth)
+            # Support for rounds at/below the new frontier can never be
+            # queried again (the leader_round <= last_committed_round
+            # short-circuit above) — prune so the dict tracks the live
+            # window only.
+            last = state.last_committed_round
+            for lr in [k for k in self._support if k <= last]:
+                del self._support[lr]
         return sequence
 
     def order_leaders(self, leader: Certificate) -> List[Certificate]:
-        """Walk back two rounds at a time, keeping leaders linked to the
-        chain (reference lib.rs:224-244)."""
-        to_commit = [leader]
+        """The whole linked-leader chain in ONE descending frontier pass
+        (reference lib.rs:224-244 walks back two rounds at a time and
+        runs a fresh ``linked()`` BFS over the window per earlier leader
+        — O(leaders × window)).  The frontier at round r is the causal
+        cone of the current chain head; when it reaches the leader of an
+        even round, that leader joins the chain and the frontier RESETS
+        to it alone — exactly the reference's ``leader = prev_leader``
+        rebinding, and exactly the semantics the device kernel's
+        ``_chain_scan`` executes (ops/reachability.py), which the r06
+        equivalence suite validated certificate-for-certificate.
+        Parent digests resolve through the digest index, so each hop is
+        O(frontier edges)."""
         state = self.state
+        index = state.digest_index
+        to_commit = [leader]
+        frontier = [leader]
         for r in range(
-            leader.round - 2, state.last_committed_round + 1, -2
+            leader.round - 1, state.last_committed_round, -1
         ):
-            got = self.leader(r, state.dag)
-            if got is None:
-                continue
-            _, prev_leader = got
-            if self.linked(leader, prev_leader, state.dag):
-                to_commit.append(prev_leader)
-                leader = prev_leader
+            wanted = set()
+            for x in frontier:
+                wanted.update(x.header.parents)
+            frontier = [
+                certificate
+                for digest in wanted
+                if (certificate := index.get(digest)) is not None
+                and certificate.round == r
+            ]
+            if not frontier:
+                # Empty causal cone: nothing deeper can be linked.
+                break
+            if r % 2 == 0:
+                got = self.leader(r, state.dag)
+                if got is None:
+                    continue
+                _, prev_leader = got
+                if any(
+                    x is prev_leader or x == prev_leader for x in frontier
+                ):
+                    to_commit.append(prev_leader)
+                    frontier = [prev_leader]
         return to_commit
 
-    def linked(
-        self, leader: Certificate, prev_leader: Certificate, dag: Dag
-    ) -> bool:
-        """Round-by-round BFS reachability (reference lib.rs:247-259).
-        This is the loop the TPU kernel re-expresses as boolean
-        adjacency-matrix products."""
-        parents = [leader]
-        for r in range(leader.round - 1, prev_leader.round - 1, -1):
-            parents = [
-                certificate
-                for digest, certificate in dag.get(r, {}).values()
-                if any(digest in x.header.parents for x in parents)
-            ]
-        return any(x is prev_leader or x == prev_leader for x in parents)
+    # NOTE: the reference's per-pair ``linked()`` BFS (lib.rs:247-259) has
+    # no standalone counterpart here — its reachability question is
+    # answered inside order_leaders' single frontier pass (the TPU kernel
+    # re-expresses the same loop as boolean adjacency-matrix products).
+    # The frozen oracle keeps the original per-pair form (golden.py).
 
     def order_dag(self, leader: Certificate) -> List[Certificate]:
         """DFS flatten of the leader's causal history, skipping
-        already-committed certificates (reference lib.rs:263-303)."""
+        already-committed certificates (reference lib.rs:263-303).
+        Parent digests resolve through the digest index in O(1); the
+        round check preserves the golden walk's only-look-one-round-down
+        discipline (a digest present at any other round is not a DAG
+        edge)."""
         state = self.state
+        index = state.digest_index
+        last_committed = state.last_committed
         ordered: List[Certificate] = []
         already_ordered = set()
         buffer = [leader]
@@ -222,16 +393,11 @@ class Tusk:
             # copies — unsorted DFS would give each node a different
             # intra-round commit order.
             for parent in sorted(x.header.parents):
-                found = None
-                for digest, certificate in state.dag.get(x.round - 1, {}).values():
-                    if digest == parent:
-                        found = (digest, certificate)
-                        break
-                if found is None:
+                certificate = index.get(parent)
+                if certificate is None or certificate.round != x.round - 1:
                     continue  # already ordered or GC'd up to here
-                digest, certificate = found
-                skip = digest in already_ordered
-                # ≥, not ==: in-process they are equivalent (State.update
+                skip = parent in already_ordered
+                # ≥, not ==: in-process they are equivalent (the gc sweep
                 # deletes every DAG entry strictly below an authority's
                 # last-committed round, so only the boundary round can
                 # still be encountered — the reference's equality check,
@@ -240,12 +406,12 @@ class Tusk:
                 # BEFORE the committed frontier and older rounds reappear;
                 # ≥ keeps them out of the sequence.
                 skip |= (
-                    state.last_committed.get(certificate.origin, -1)
+                    last_committed.get(certificate.origin, -1)
                     >= certificate.round
                 )
                 if not skip:
                     buffer.append(certificate)
-                    already_ordered.add(digest)
+                    already_ordered.add(parent)
         # Never commit garbage-collected certificates.
         ordered = [
             x
@@ -259,6 +425,11 @@ class Tusk:
 class Consensus:
     """Async runner: certificates in from the primary, ordered certificates
     out to the application and back to the primary for GC."""
+
+    # Upper bound on certificates drained per wakeup: keeps one flood from
+    # monopolizing the loop while still collapsing a backlog into one
+    # scheduling slice.
+    MAX_DRAIN = 256
 
     def __init__(
         self,
@@ -288,6 +459,13 @@ class Consensus:
         self._m_batches = metrics.counter("consensus.committed_batch_digests")
         self._m_commit_batch = metrics.histogram(
             "consensus.commit_batch_size", metrics.COUNT_BUCKETS
+        )
+        # Commit-path attribution (PR 4): how long one triggering
+        # process_certificate call takes (insert + chain walk + flatten),
+        # and how many queued certificates each runner wakeup drains.
+        self._m_walk = metrics.histogram("consensus.commit_walk_seconds")
+        self._m_drain = metrics.histogram(
+            "consensus.drain_batch_size", metrics.COUNT_BUCKETS
         )
         self._m_round = metrics.gauge("consensus.last_committed_round")
         self._m_lag = metrics.gauge("consensus.commit_lag_rounds")
@@ -337,53 +515,106 @@ class Consensus:
 
     async def run(self) -> None:
         while True:
-            certificate = await self.rx_primary.get()
-            self._m_certs_in.inc()
-            sequence = self.tusk.process_certificate(certificate)
-            state = self.tusk.state
-            # Committed-certificate lag: how far the DAG head has run ahead
-            # of the committed frontier.  A steadily growing lag means the
-            # commit rule is starved (missing leader support) while
-            # certificates keep arriving.
-            self._m_lag.set(
-                max(0, certificate.round - state.last_committed_round)
-            )
-            self._m_round.set(state.last_committed_round)
-            if sequence:
-                self._m_commits.inc(len(sequence))
-                self._m_commit_batch.observe(len(sequence))
-            for committed in sequence:
-                header = committed.header
-                self._m_batches.inc(len(header.payload))
-                for digest in header.payload:
-                    self._mtrace.mark(bytes(digest).hex(), "commit")
-                if self.benchmark and header.payload:
-                    for digest in header.payload:
-                        # Parsed by the benchmark log parser (reference
-                        # lib.rs:185-189).
-                        log.info(
-                            "Committed B%d(%r) -> %r",
-                            header.round,
-                            header.id,
-                            digest,
+            # Burst-drain: one wakeup processes the whole backlog (a sync
+            # release, a slow scheduling slice on a shared core, or a
+            # catch-up flood queues many certificates), instead of paying
+            # one task switch per certificate.
+            batch = [await self.rx_primary.get()]
+            while len(batch) < self.MAX_DRAIN:
+                try:
+                    batch.append(self.rx_primary.get_nowait())
+                except asyncio.QueueEmpty:
+                    break
+            self._m_drain.observe(len(batch))
+            committed_any = False
+            for certificate in batch:
+                self._m_certs_in.inc()
+                # cert_inserted: the certificate's payload entered the
+                # commit rule's state — the start of the cert→commit
+                # sub-span attribution.
+                if certificate.header.payload:
+                    now = time.time()
+                    for digest in certificate.header.payload:
+                        self._mtrace.mark(
+                            bytes(digest).hex(), "cert_inserted", ts=now
                         )
-                else:
-                    log.info("Committed B%d(%r)", header.round, header.id)
-                await self.tx_primary.put(committed)
-                await self.tx_output.put(committed)
-            if sequence and self.checkpoint_path is not None:
-                # One atomic rewrite per commit batch, AFTER delivery: a
-                # crash in the window re-delivers at most this one batch
-                # on restart (at-least-once at the boundary, dedupable by
+                t0 = time.time()
+                sequence = self.tusk.process_certificate(certificate)
+                t_walk = time.time()
+                state = self.tusk.state
+                # Committed-certificate lag: how far the DAG head has run
+                # ahead of the committed frontier.  A steadily growing lag
+                # means the commit rule is starved (missing leader
+                # support) while certificates keep arriving.
+                self._m_lag.set(
+                    max(0, certificate.round - state.last_committed_round)
+                )
+                self._m_round.set(state.last_committed_round)
+                if sequence:
+                    committed_any = True
+                    self._m_commits.inc(len(sequence))
+                    self._m_commit_batch.observe(len(sequence))
+                    self._m_walk.observe(t_walk - t0)
+                for committed in sequence:
+                    header = committed.header
+                    self._m_batches.inc(len(header.payload))
+                    for digest in header.payload:
+                        h = bytes(digest).hex()
+                        # commit_trigger: the arrival that fired the
+                        # commit rule (cadence boundary); walk_done: the
+                        # chain walk + flatten finished (walk cost).
+                        self._mtrace.mark(h, "commit_trigger", ts=t0)
+                        self._mtrace.mark(h, "walk_done", ts=t_walk)
+                    if self.benchmark and header.payload:
+                        for digest in header.payload:
+                            # Parsed by the benchmark log parser (reference
+                            # lib.rs:185-189).
+                            log.info(
+                                "Committed B%d(%r) -> %r",
+                                header.round,
+                                header.id,
+                                digest,
+                            )
+                    else:
+                        log.info("Committed B%d(%r)", header.round, header.id)
+                    await self.tx_primary.put(committed)
+                    await self.tx_output.put(committed)
+                    if header.payload:
+                        # commit: delivered downstream (the remaining leg
+                        # is queue/backpressure, not protocol).
+                        now = time.time()
+                        for digest in header.payload:
+                            self._mtrace.mark(
+                                bytes(digest).hex(), "commit", ts=now
+                            )
+            if committed_any and self.checkpoint_path is not None:
+                # One atomic rewrite per drained burst, AFTER delivery: a
+                # crash in the window re-delivers at most this burst on
+                # restart (at-least-once at the boundary, dedupable by
                 # certificate digest downstream) instead of silently
                 # LOSING it, which nothing downstream could repair.
-                tmp = self.checkpoint_path + ".tmp"
-                with open(tmp, "wb") as f:
-                    f.write(self.tusk.state.snapshot_bytes())
-                    # fsync BEFORE the rename: os.replace is atomic against
-                    # process crash, but on power loss the rename can become
-                    # durable before the data, leaving a torn file under the
-                    # final name (ADVICE.md r05).
-                    f.flush()
-                    os.fsync(f.fileno())
-                os.replace(tmp, self.checkpoint_path)
+                # The write+fsync runs in the default executor: an fsync
+                # on the event loop blocked the ENTIRE primary process
+                # (proposer, core) for the disk's flush latency per
+                # commit burst — commit-path work slowing round cadence
+                # itself.  Awaiting here still serializes rewrites within
+                # this task (no torn interleavings), and the checkpoint's
+                # crash-recovery semantics tolerate the added staleness
+                # (it is an optimization; at worst one more burst
+                # re-delivers).
+                blob = self.tusk.state.snapshot_bytes()
+                await asyncio.get_running_loop().run_in_executor(
+                    None, self._write_checkpoint, blob
+                )
+
+    def _write_checkpoint(self, blob: bytes) -> None:
+        tmp = self.checkpoint_path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+            # fsync BEFORE the rename: os.replace is atomic against
+            # process crash, but on power loss the rename can become
+            # durable before the data, leaving a torn file under the
+            # final name (ADVICE.md r05).
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.checkpoint_path)

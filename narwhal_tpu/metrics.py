@@ -75,14 +75,21 @@ COUNT_BUCKETS: Tuple[float, ...] = (
 
 # Pipeline stages, in causal order.  TraceTable.mark validates against this
 # so a typo'd stage name fails loudly in tests instead of silently skewing
-# the bench breakdown.
+# the bench breakdown.  The last four stages subdivide the old opaque
+# cert→commit span (77% of seal→commit in the r07 breakdown) so the bench
+# attributes where that time goes: protocol cadence (cert_inserted →
+# commit_trigger, rounds until the odd-round trigger), walk cost
+# (commit_trigger → walk_done), and delivery (walk_done → commit).
 STAGES: Tuple[str, ...] = (
     "seal",               # worker: batch sealed (BatchMaker._seal)
     "quorum",             # worker: 2f+1 ACK stake reached (QuorumWaiter)
     "digest_at_primary",  # primary: own digest reached the Proposer
     "header",             # primary: digest included in a created header
     "cert",               # primary: own header's certificate assembled
-    "commit",             # consensus: containing header committed
+    "cert_inserted",      # consensus: containing certificate entered Tusk
+    "commit_trigger",     # consensus: the arrival that fired the commit rule
+    "walk_done",          # consensus: chain walk + causal flatten finished
+    "commit",             # consensus: committed certificate delivered
 )
 
 
@@ -539,6 +546,7 @@ def default_rules(env: Optional[Mapping[str, str]] = None) -> List[HealthRule]:
     retrans_max = f("NARWHAL_HEALTH_PEER_RETRANS_RATE", 10)
     retrans_window = f("NARWHAL_HEALTH_PEER_RETRANS_WINDOW_S", 5)
     peer_failures = f("NARWHAL_HEALTH_PEER_FAILURES", 3)
+    quorum_wedge_s = f("NARWHAL_HEALTH_QUORUM_WEDGE_S", 10)
 
     def commit_lag(ctx: HealthContext) -> Dict[str, dict]:
         v = ctx.gauge("consensus.commit_lag_rounds")
@@ -591,6 +599,26 @@ def default_rules(env: Optional[Mapping[str, str]] = None) -> List[HealthRule]:
                 }
         return out
 
+    def quorum_wedge(ctx: HealthContext) -> Dict[str, dict]:
+        # A worker's QuorumWaiter stuck mid-batch (e.g. at 2f stake with
+        # the last ACK never arriving) previously showed only indirectly
+        # via pending-ACK growth; the wait-age gauge names the wedge
+        # directly, with the acked stake vs threshold in the detail.
+        age = ctx.gauge("worker.quorum_wait_age_seconds")
+        if age is None or age <= quorum_wedge_s:
+            return {}
+        detail = {
+            "seconds_waiting": round(age, 1),
+            "threshold": quorum_wedge_s,
+        }
+        stake = ctx.gauge("worker.quorum_acked_stake")
+        need = ctx.gauge("worker.quorum_threshold")
+        if stake is not None:
+            detail["acked_stake"] = stake
+        if need is not None:
+            detail["quorum_threshold"] = need
+        return {"": detail}
+
     def peer_unreachable(ctx: HealthContext) -> Dict[str, dict]:
         out = {}
         for peer, v in ctx.gauges_prefixed(
@@ -626,6 +654,10 @@ def default_rules(env: Optional[Mapping[str, str]] = None) -> List[HealthRule]:
         # evaluation interval of the failure gauge crossing the
         # threshold (the failover tier-1 test pins this down).
         HealthRule("peer_unreachable", peer_unreachable, for_intervals=1),
+        # for_intervals=2: the wait-age gauge is itself a duration (the
+        # threshold debounces), but one extra interval rides out a
+        # callback-gauge sample racing the waiter's release.
+        HealthRule("quorum_wedge", quorum_wedge, for_intervals=2),
     ]
 
 

@@ -525,9 +525,7 @@ class KernelTusk(Tusk):
             W,
         ).block_until_ready()
 
-    def _leader_name(self, round_: int):
-        coin = 0 if self.fixed_coin else round_
-        return self._sorted_keys[coin % len(self._sorted_keys)]
+    # _leader_name is inherited from Tusk (the indexed base class).
 
     def order_leaders(self, leader) -> List:
         state = self.state

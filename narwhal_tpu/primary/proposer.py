@@ -11,7 +11,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import os
-from typing import List, Tuple
+from typing import List, Optional, Tuple
 
 _TRACE = bool(os.environ.get("NARWHAL_TRACE"))
 
@@ -53,6 +53,17 @@ class Proposer:
         self._m_headers = metrics.counter("primary.headers_proposed")
         self._m_payload_digests = metrics.counter("primary.payload_digests")
         self._m_round = metrics.gauge("primary.round")
+        # Round period: seconds between consecutive round advances.  The
+        # cert→commit attribution (PR 4) shows commit latency is
+        # dominated by protocol cadence — this histogram is the cadence
+        # denominator (cert_inserted→commit_trigger ≈ commit depth ×
+        # this), so a slow commit path reads directly as either a slow
+        # round period (look here) or a starved commit rule (look at
+        # consensus.commit_lag_rounds).
+        self._m_round_advance = metrics.histogram(
+            "primary.round_advance_seconds"
+        )
+        self._last_advance: Optional[float] = None
         self._mtrace = metrics.trace()
 
     async def _make_header(self) -> None:
@@ -107,6 +118,12 @@ class Proposer:
                         # Advance to the next round.
                         self.round = round + 1
                         self._m_round.set(self.round)
+                        now = loop.time()
+                        if self._last_advance is not None:
+                            self._m_round_advance.observe(
+                                now - self._last_advance
+                            )
+                        self._last_advance = now
                         log.debug("Dag moved to round %d", self.round)
                         self.last_parents = parents
                 if workers_get in done:

@@ -11,6 +11,8 @@ from __future__ import annotations
 import asyncio
 import logging
 import os
+import time
+from typing import Optional
 
 from .. import metrics
 from ..config import Committee
@@ -36,9 +38,26 @@ class QuorumWaiter:
         self._m_reached = metrics.counter("worker.quorum_reached")
         self._m_dropped = metrics.counter("worker.quorum_dropped")
         self._mtrace = metrics.trace()
+        # Live wedge detection (health rule `quorum_wedge`): how long the
+        # CURRENT batch has been waiting for quorum (0 when idle) and how
+        # much stake has ACKed it so far.  A waiter stuck at 2f stake —
+        # one ACK short, forever — previously surfaced only via
+        # pending-ACK growth on the sender; these gauges name it.
+        self._wait_started: Optional[float] = None
+        self._m_acked_stake = metrics.gauge("worker.quorum_acked_stake")
+        self._m_threshold = metrics.gauge("worker.quorum_threshold")
+        metrics.gauge_fn(
+            "worker.quorum_wait_age_seconds",
+            lambda: (
+                0.0
+                if self._wait_started is None
+                else max(0.0, time.time() - self._wait_started)
+            ),
+        )
 
     async def run(self) -> None:
         threshold = self.committee.quorum_threshold()
+        self._m_threshold.set(threshold)
         loop = asyncio.get_running_loop()
         while True:
             digest, serialized, handlers = await self.in_queue.get()
@@ -47,7 +66,9 @@ class QuorumWaiter:
             # wire + peer validation + ACK return (minus queue time in
             # to_quorum, which the queue-depth gauge exposes separately).
             t0 = loop.time()
+            self._wait_started = time.time()
             total = self.committee.stake(self.name)  # our own stake counts
+            self._m_acked_stake.set(total)
             pending = {fut: stake for stake, fut in handlers}
             while total < threshold and pending:
                 done, _ = await asyncio.wait(
@@ -57,9 +78,12 @@ class QuorumWaiter:
                     stake = pending.pop(fut)
                     if not fut.cancelled() and fut.exception() is None:
                         total += stake
+                self._m_acked_stake.set(total)
             # Quorum reached (or unreachable): abandon in-flight deliveries.
             for fut in pending:
                 fut.cancel()
+            self._wait_started = None
+            self._m_acked_stake.set(0)
             if total >= threshold:
                 self._m_latency.observe(loop.time() - t0)
                 self._m_reached.inc()

@@ -240,11 +240,21 @@ def test_corrupt_checkpoint_boots_fresh_and_commits(tmp_path):
             await rx.put(cert)
         out = [await asyncio.wait_for(tx_output.get(), 5) for _ in range(5)]
         assert [x.round for x in out] == [1, 1, 1, 1, 2]
+        # The commit rewrote the checkpoint: a restart now restores
+        # cleanly.  The rewrite runs in the executor (off the event
+        # loop, PR 4), so poll for the write to land BEFORE cancelling
+        # the runner — cancelling first could cancel a not-yet-started
+        # executor job and the file would never appear.
+        state = Tusk(c, gc_depth=50, fixed_coin=True).state
+        for _ in range(100):
+            with open(ckpt, "rb") as f:
+                blob = f.read()
+            try:
+                state.restore(blob)
+                break
+            except ValueError:
+                await asyncio.sleep(0.05)
         task.cancel()
-        # The commit rewrote the checkpoint: a restart now restores cleanly.
-        with open(ckpt, "rb") as f:
-            state = Tusk(c, gc_depth=50, fixed_coin=True).state
-            state.restore(f.read())
         assert state.last_committed_round == 2
 
     asyncio.run(asyncio.wait_for(go(), 15))

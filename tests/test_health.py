@@ -381,3 +381,160 @@ def test_scraper_start_stop_collects_over_time():
         assert all(s["node"] == "n0" for s in scraper.samples)
     finally:
         srv.stop()
+
+
+# -- quorum-waiter wedge rule (worker-side; PR 4) -----------------------------
+
+def test_quorum_wedge_rule_fires_and_clears_deterministically():
+    """evaluate()-injection drive of the quorum_wedge rule: a wait-age
+    gauge past NARWHAL_HEALTH_QUORUM_WEDGE_S fires after for_intervals=2
+    breaches, names the acked stake vs threshold in the detail, and
+    clears once the waiter releases."""
+    reg = Registry()
+    age = {"v": 0.0}
+    reg.gauge_fn("worker.quorum_wait_age_seconds", lambda: age["v"])
+    reg.gauge("worker.quorum_acked_stake").set(2)  # wedged at 2f
+    reg.gauge("worker.quorum_threshold").set(3)
+    mon = HealthMonitor(
+        reg,
+        rules=default_rules({"NARWHAL_HEALTH_QUORUM_WEDGE_S": "5"}),
+        interval_s=1.0,
+    )
+    t = 2000.0
+    assert mon.evaluate(t) == []
+    age["v"] = 6.0
+    assert mon.evaluate(t + 1) == []  # first breach: hysteresis holds
+    age["v"] = 7.0
+    firing = mon.evaluate(t + 2)
+    assert [f["rule"] for f in firing] == ["quorum_wedge"]
+    detail = firing[0]["detail"]
+    assert detail["acked_stake"] == 2
+    assert detail["quorum_threshold"] == 3
+    assert detail["seconds_waiting"] == 7.0
+    # Waiter releases (age back to 0): clears after clear_intervals=2.
+    age["v"] = 0.0
+    reg.gauges["worker.quorum_acked_stake"].set(0)
+    mon.evaluate(t + 3)
+    assert mon.evaluate(t + 4) == []
+    assert [e["event"] for e in mon.events] == ["FIRING", "cleared"]
+
+
+def test_quorum_waiter_exports_wedge_gauges():
+    """A live QuorumWaiter stuck one ACK short of quorum exports a
+    growing wait-age gauge and the acked stake so far; releasing the
+    last ACK zeroes both."""
+    import time as _time
+
+    from narwhal_tpu.worker.quorum_waiter import QuorumWaiter
+    from tests.common import committee, keys
+
+    reg = metrics.registry()
+    reg.reset()
+
+    async def go():
+        c = committee()
+        kp = keys()[0]
+        loop = asyncio.get_running_loop()
+        in_q, out_q = asyncio.Queue(), asyncio.Queue()
+        waiter = QuorumWaiter(kp.name, c, in_q, out_q)
+        task = loop.create_task(waiter.run())
+        # 3 peer ACK futures (stake 1 each); quorum threshold is 3, our
+        # own stake counts 1 — resolve one, leave the waiter at 2 < 3.
+        futs = [loop.create_future() for _ in range(3)]
+        digest = b"\x01" * 32
+        await in_q.put((digest, b"batch", [(1, f) for f in futs]))
+        futs[0].set_result(None)
+        deadline = _time.time() + 5
+        while (
+            reg.gauges["worker.quorum_acked_stake"].value < 2
+            and _time.time() < deadline
+        ):
+            await asyncio.sleep(0.01)
+        assert reg.gauges["worker.quorum_acked_stake"].value == 2
+        assert reg.gauges["worker.quorum_threshold"].value == 3
+        await asyncio.sleep(0.05)
+        assert reg.gauge_fns["worker.quorum_wait_age_seconds"]() > 0.0
+        # Third ACK releases the batch: gauges reset, batch forwarded.
+        futs[1].set_result(None)
+        got = await asyncio.wait_for(out_q.get(), 5)
+        assert got[0] == digest
+        assert reg.gauges["worker.quorum_acked_stake"].value == 0
+        assert reg.gauge_fns["worker.quorum_wait_age_seconds"]() == 0.0
+        task.cancel()
+
+    asyncio.run(asyncio.wait_for(go(), 15))
+
+
+# -- anomaly events as a first-class timeline track (PR 4) --------------------
+
+def test_build_timeline_renders_anomaly_event_track():
+    """HealthMonitor FIRING/cleared transitions ride the scraped samples'
+    cumulative events ring; build_timeline must dedupe them into one
+    committee-wide, time-sorted `events` track naming rule + subject +
+    fire/clear timestamps, merged with the quiesce /healthz bodies."""
+    from benchmark.metrics_check import build_timeline
+
+    reg = Registry()
+    g = reg.gauge("t.val")
+    mon = HealthMonitor(
+        reg, rules=[_ceiling_rule(for_intervals=1)], interval_s=1.0
+    )
+    reg.health = mon
+
+    def sample(t):
+        return {
+            "t": t,
+            "node": "primary-0",
+            "counters": {},
+            "gauges": {},
+            "histograms": {},
+            "health": mon.health_snapshot(),
+        }
+
+    t = 3000.0
+    mon.evaluate(t)
+    samples = [sample(t)]
+    g.set(99)
+    mon.evaluate(t + 1)  # FIRING at t+1
+    samples.append(sample(t + 1))
+    g.set(0)
+    mon.evaluate(t + 2)
+    mon.evaluate(t + 3)  # cleared at t+3
+    samples.append(sample(t + 3))
+    # The ring is cumulative: the same FIRING event appears in samples 2
+    # and 3 — the track must carry it once.
+    healthz = {"primary-0": (200, mon.health_snapshot())}
+
+    timeline = build_timeline(samples, interval_s=1.0, healthz=healthz)
+    events = timeline["events"]
+    assert [(e["event"], e["t"]) for e in events] == [
+        ("FIRING", t + 1),
+        ("cleared", t + 3),
+    ]
+    assert all(e["rule"] == "ceiling" for e in events)
+    assert all(e["node"] == "primary-0" for e in events)
+    assert events[0]["detail"]["value"] == 99
+    # Per-sample firing counts still ride along next to the track.
+    series = timeline["nodes"]["primary-0"]
+    assert [p["health_firing"] for p in series] == [0, 1, 0]
+
+
+def test_build_timeline_events_from_quiesce_healthz_only():
+    """A transition after the last scrape tick still lands in the track
+    via the /healthz body (the quiesce probe)."""
+    from benchmark.metrics_check import build_timeline
+
+    reg = Registry()
+    reg.gauge("t.val").set(50)
+    mon = HealthMonitor(
+        reg, rules=[_ceiling_rule(for_intervals=1)], interval_s=1.0
+    )
+    reg.health = mon
+    mon.evaluate(4000.0)
+    timeline = build_timeline(
+        [], interval_s=1.0, healthz={"w-0": (503, mon.health_snapshot())}
+    )
+    assert [(e["node"], e["event"]) for e in timeline["events"]] == [
+        ("w-0", "FIRING")
+    ]
+    assert timeline["healthz"]["w-0"]["firing"] == ["ceiling"]

@@ -327,9 +327,11 @@ def test_cross_validate_agreement_and_failure():
     })
     primary = snap({
         "d1": {"digest_at_primary": 1.2, "header": 1.3, "cert": 1.5,
-               "commit": 1.9},
+               "cert_inserted": 1.6, "commit_trigger": 1.8,
+               "walk_done": 1.85, "commit": 1.9},
         "d2": {"digest_at_primary": 2.2, "header": 2.3, "cert": 2.5,
-               "commit": 2.9},
+               "cert_inserted": 2.6, "commit_trigger": 2.8,
+               "walk_done": 2.85, "commit": 2.9},
     })
 
     r = ParseResult(committed_bytes=102400)
@@ -339,9 +341,23 @@ def test_cross_validate_agreement_and_failure():
     assert r.metrics_disagreement == 0.0
     assert summary["traced_full_chain"] == 2
     # Mean per-leg latencies (both batches identical): e.g. seal→quorum
-    # 100 ms, cert→commit 400 ms, full chain 900 ms.
+    # 100 ms, cert→commit 400 ms, full chain 900 ms.  cert→commit is
+    # reported BOTH as the aggregate leg (the number every prior artifact
+    # tracks) and as its new sub-stages.
     assert math.isclose(r.stages_ms["seal_to_quorum"], 100.0, abs_tol=0.2)
     assert math.isclose(r.stages_ms["cert_to_commit"], 400.0, abs_tol=0.2)
+    assert math.isclose(
+        r.stages_ms["cert_to_cert_inserted"], 100.0, abs_tol=0.2
+    )
+    assert math.isclose(
+        r.stages_ms["cert_inserted_to_commit_trigger"], 200.0, abs_tol=0.2
+    )
+    assert math.isclose(
+        r.stages_ms["commit_trigger_to_walk_done"], 50.0, abs_tol=0.2
+    )
+    assert math.isclose(
+        r.stages_ms["walk_done_to_commit"], 50.0, abs_tol=0.2
+    )
     assert math.isclose(r.stages_ms["seal_to_commit"], 900.0, abs_tol=0.2)
 
     # >5% disagreement between channels is fatal.

@@ -325,6 +325,49 @@ def test_device_window_matches_dict_dag_rebuild():
         assert ((np.asarray(k._dev_parent) > 0) == want_parent).all()
 
 
+def test_kernel_digest_index_tracks_dict_dag():
+    """White-box (PR 4): KernelTusk inherits the indexed base state, so
+    after arbitrary feeds (commits, window shifts, GC) the digest index
+    must hold exactly the certificates currently in the dict DAG — the
+    host-side seam the kernel's fallback walk and order_dag flattening
+    both resolve parents through."""
+    rng = random.Random(0x1DE)
+    for gc_depth in (50, 6):
+        certs = _random_dag_certs(rng, rounds=rng.randint(10, 20))
+        k = KernelTusk(committee(), gc_depth=gc_depth, fixed_coin=True)
+        feed(k, certs)
+        want = {
+            d: cert
+            for authorities in k.state.dag.values()
+            for (d, cert) in authorities.values()
+        }
+        assert dict(k.state.digest_index) == want
+
+
+def test_kernel_support_counters_match_rescan():
+    """White-box (PR 4): the incremental f+1 support counters the kernel
+    inherits must equal a from-scratch rescan of each queryable leader
+    round, even under the out-of-order delivery that exercises the
+    leader-seeding path."""
+    rng = random.Random(0x1DF)
+    for trial in range(3):
+        certs = _random_dag_certs(rng, rounds=rng.randint(8, 16))
+        order = sorted(certs, key=lambda x: x.round + rng.uniform(-2.2, 0.0))
+        k = KernelTusk(committee(), gc_depth=50, fixed_coin=True)
+        feed(k, order)
+        top = max(k.state.dag)
+        for lr in range(k.state.last_committed_round + 2, top + 1, 2):
+            got = k.leader(lr, k.state.dag)
+            want = 0
+            if got is not None:
+                want = sum(
+                    k.committee.stake(cert.origin)
+                    for _, cert in k.state.dag.get(lr + 1, {}).values()
+                    if got[0] in cert.header.parents
+                )
+            assert k._support.get(lr, 0) == want, (trial, lr)
+
+
 def test_arrival_path_stages_without_device_dispatch():
     """The arrival path must be a bare staging append: no window_apply
     dispatch until a commit opportunity flushes the batch."""
