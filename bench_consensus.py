@@ -68,16 +68,21 @@ from narwhal_tpu.consensus.tusk import Tusk  # noqa: E402
 from narwhal_tpu.primary.messages import Certificate, Header, genesis  # noqa: E402
 
 
-def make_committee(n: int) -> Committee:
+def make_committee(n: int, return_keypairs: bool = False):
+    """Seeded stake-1 loopback committee — the shared microbench fixture
+    (bench_cadence.py imports this; keep the one construction site)."""
+    kps = [
+        KeyPair.generate(rng_seed=i.to_bytes(32, "little")) for i in range(n)
+    ]
     auths = {}
-    for i in range(n):
-        kp = KeyPair.generate(rng_seed=i.to_bytes(32, "little"))
+    for kp in kps:
         auths[kp.name] = Authority(
             stake=1,
             primary=PrimaryAddresses("127.0.0.1:0", "127.0.0.1:0"),
             workers={0: WorkerAddresses("127.0.0.1:0", "127.0.0.1:0", "127.0.0.1:0")},
         )
-    return Committee(auths)
+    committee = Committee(auths)
+    return (committee, kps) if return_keypairs else committee
 
 
 def mock_certificate(origin, round_, parents) -> Certificate:

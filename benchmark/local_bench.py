@@ -122,6 +122,7 @@ def run_bench(
     header_size: int = 1_000,
     batch_size: int = 500_000,
     max_header_delay: int = 100,
+    min_header_delay: int = 0,
     max_batch_delay: int = 100,
     workdir: str = None,
     keep_logs: bool = False,
@@ -169,6 +170,7 @@ def run_bench(
         header_size=header_size,
         batch_size=batch_size,
         max_header_delay=max_header_delay,
+        min_header_delay=min_header_delay,
         max_batch_delay=max_batch_delay,
     )
     params.export(f"{workdir}/parameters.json")
@@ -466,6 +468,15 @@ def main():
     parser.add_argument("--duration", type=int, default=20)
     parser.add_argument("--faults", type=int, default=0)
     parser.add_argument("--base-port", type=int, default=7000)
+    parser.add_argument(
+        "--min-header-delay",
+        type=int,
+        default=0,
+        help="Sui-style cadence floor (ms): a parent quorum plus any "
+        "payload proposes after this delay instead of riding "
+        "--max-header-delay; 0 = reference behavior",
+    )
+    parser.add_argument("--max-header-delay", type=int, default=100)
     parser.add_argument("--json", action="store_true")
     parser.add_argument("--crypto-backend", choices=["cpu", "tpu"], default=None)
     parser.add_argument(
@@ -493,6 +504,8 @@ def main():
         duration=args.duration,
         faults=args.faults,
         base_port=args.base_port,
+        min_header_delay=args.min_header_delay,
+        max_header_delay=args.max_header_delay,
         crypto_backend=args.crypto_backend,
         consensus_kernel=args.consensus_kernel,
         tpu_primaries=args.tpu_primaries,
@@ -517,6 +530,9 @@ def main():
                     # commit, mean ms per leg) and the cross-check of the
                     # two measurement channels.
                     "stages_ms": result.stages_ms,
+                    # Round-cadence attribution: mean ms per ROUND_STAGES
+                    # sub-leg (telescoping to the round period).
+                    "round_stages_ms": result.round_stages_ms,
                     "metrics_committed_tx": round(
                         result.metrics_committed_tx, 1
                     ),
@@ -533,6 +549,10 @@ def main():
             print(" + PIPELINE STAGES (mean ms):")
             for name, ms in result.stages_ms.items():
                 print(f"   {name}: {ms:,.1f} ms")
+        if result.round_stages_ms:
+            print(" + ROUND CADENCE (mean ms per sub-leg):")
+            for name, ms in result.round_stages_ms.items():
+                print(f"   {name}: {ms:,.2f} ms")
         # Outside the stages guard: the disagreement matters MOST when the
         # stage join came up empty (missed flush, eviction).
         if result.metrics_disagreement is not None:

@@ -40,6 +40,7 @@ _TS = r"(\d{4}-\d{2}-\d{2}T\d{2}:\d{2}:\d{2}\.\d{3})Z"
 _CONFIG_PATTERNS = [
     ("header_size", r"Header size set to (\d+) B"),
     ("max_header_delay", r"Max header delay set to (\d+) ms"),
+    ("min_header_delay", r"Min header delay set to (\d+) ms"),
     ("gc_depth", r"Garbage collection depth set to (\d+) rounds"),
     ("sync_retry_delay", r"Sync retry delay set to (\d+) ms"),
     ("sync_retry_nodes", r"Sync retry nodes set to (\d+) nodes"),
@@ -96,6 +97,10 @@ class ParseResult:
     metrics_committed_tx: float = 0.0
     metrics_disagreement: float | None = None
     stages_ms: Dict[str, float] = field(default_factory=dict)
+    # Round-cadence attribution (per-round ROUND_STAGES legs aggregated
+    # across primaries — see metrics_check.round_attribution): mean ms per
+    # sub-leg plus the telescoped round period they sum to.
+    round_stages_ms: Dict[str, float] = field(default_factory=dict)
     # Committee-wide time-series scraped live from every node's
     # --metrics-port during the run (benchmark/scraper.py →
     # metrics_check.build_timeline): per-node TPS/round/commit-lag over

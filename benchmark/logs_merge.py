@@ -1,0 +1,113 @@
+"""Merge per-node ``--log-json`` streams into one committee-wide JSONL.
+
+Every node run with ``--log-json`` emits one-line-JSON records
+({ts, level, logger, msg, node}, ts = unix epoch seconds — see
+node/main.py JsonLogFormatter), but each process writes its own file and
+nothing joined them: reconstructing "what did the committee do at t?"
+meant eyeballing 8+ files side by side (the ROADMAP observability
+follow-up).  This tool is the join: a k-way heap merge by timestamp into
+a single time-sorted JSONL stream, one record per line, each tagged with
+its node id.
+
+    python benchmark/logs_merge.py .bench/primary-*.log -o committee.jsonl
+    python benchmark/logs_merge.py .bench/*.log | jq 'select(.level=="WARNING")'
+
+Robustness rules (a merged stream that silently drops lines is worse
+than none):
+
+- A record missing ``node`` inherits the source file's stem, so plain
+  ``--log-json`` output that predates the node tag still merges.
+- A non-JSON line (tracebacks from the logging machinery itself, stray
+  prints) is wrapped as ``{"ts": <last seen ts in that file>, "level":
+  "RAW", "msg": <line>, "node": <stem>}`` and sorts at its neighbor's
+  position instead of being dropped.
+- A record missing ``ts`` sorts with the file's last seen timestamp
+  (0.0 at file start), keeping it adjacent to its context.
+
+The merge is streaming (heapq.merge over lazy per-file iterators): a
+committee-day of logs never loads into memory at once.
+"""
+
+from __future__ import annotations
+
+import argparse
+import heapq
+import json
+import os
+import sys
+from typing import Iterable, Iterator, List, TextIO, Tuple
+
+
+def _records(path: str, text: Iterable[str]) -> Iterator[Tuple[float, dict]]:
+    """(ts, record) per line of one node's stream."""
+    stem = os.path.splitext(os.path.basename(path))[0]
+    last_ts = 0.0
+    for line in text:
+        line = line.rstrip("\n")
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+            if not isinstance(rec, dict):
+                raise ValueError("not an object")
+        except ValueError:
+            rec = {"ts": last_ts, "level": "RAW", "msg": line}
+        ts = rec.get("ts")
+        if isinstance(ts, (int, float)):
+            last_ts = float(ts)
+        else:
+            rec["ts"] = last_ts
+        rec.setdefault("node", stem)
+        yield (rec["ts"], rec)
+
+
+def merge_streams(
+    named_texts: List[Tuple[str, Iterable[str]]], out: TextIO
+) -> int:
+    """K-way timestamp merge; returns the number of records written.
+    ``named_texts`` is [(source name, line iterable), …] — file handles,
+    lists of lines in tests, anything iterable.  heapq.merge with a key
+    is stable, so same-timestamp records keep within-file order and the
+    record dicts themselves are never compared."""
+    streams = [_records(name, text) for name, text in named_texts]
+    n = 0
+    for _, rec in heapq.merge(*streams, key=lambda t: t[0]):
+        out.write(json.dumps(rec) + "\n")
+        n += 1
+    return n
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Merge per-node --log-json files into one time-sorted "
+        "committee-wide JSONL stream (node tag per line)."
+    )
+    parser.add_argument("logs", nargs="+", help="per-node JSONL log files")
+    parser.add_argument(
+        "-o",
+        "--output",
+        default=None,
+        help="output path (default: stdout)",
+    )
+    args = parser.parse_args(argv)
+
+    handles = [open(p) for p in args.logs]
+    try:
+        if args.output:
+            with open(args.output, "w") as out:
+                n = merge_streams(list(zip(args.logs, handles)), out)
+            print(
+                f"merged {n} records from {len(args.logs)} node(s) "
+                f"into {args.output}",
+                file=sys.stderr,
+            )
+        else:
+            merge_streams(list(zip(args.logs, handles)), sys.stdout)
+    finally:
+        for h in handles:
+            h.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

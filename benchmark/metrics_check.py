@@ -26,10 +26,14 @@ from typing import Dict, List, Optional, Tuple
 # Causal stage order: the registry's definition IS the source of truth
 # (a hand-copied tuple here would silently drop any future stage from
 # the breakdown).
-from narwhal_tpu.metrics import STAGES as STAGE_ORDER
+from narwhal_tpu.metrics import ROUND_STAGES, STAGES as STAGE_ORDER
 
 STAGE_LEGS: Tuple[Tuple[str, str], ...] = tuple(
     zip(STAGE_ORDER[:-1], STAGE_ORDER[1:])
+)
+
+ROUND_LEGS: Tuple[Tuple[str, str], ...] = tuple(
+    zip(ROUND_STAGES[:-1], ROUND_STAGES[1:])
 )
 
 
@@ -160,6 +164,12 @@ def cross_validate(
         # In-band annotation next to the numbers the evictions bias.
         result.stages_ms["trace_evictions"] = float(evictions)
 
+    # Round-cadence attribution: the per-round sub-stage legs that
+    # decompose `primary.round_advance_seconds` the way the sub-stages
+    # above decompose cert→commit.
+    round_attr = round_attribution(snapshots)
+    result.round_stages_ms = dict(round_attr.get("round_stages_ms", {}))
+
     return {
         "stages_ms": dict(result.stages_ms),
         "traced_full_chain": len(totals),
@@ -169,7 +179,101 @@ def cross_validate(
         "disagreement": (
             round(disagreement, 4) if disagreement is not None else None
         ),
+        "round_attribution": round_attr,
     }
+
+
+def round_attribution(snapshots: List[dict]) -> dict:
+    """Decompose the round period from the per-round cadence traces.
+
+    Each primary stamps ROUND_STAGES per round of its own header
+    lifecycle (header_proposed → … → round_advance).  Unlike the digest
+    trace these are NOT joined across nodes — every primary runs its own
+    cadence loop — so legs aggregate over (node, round) pairs.  The
+    leading ``advance_to_header_proposed`` leg (previous round's advance
+    to this round's mint — the proposer's min/max-header-delay wait) is
+    derived here, which makes the legs TELESCOPE: their sum for round r
+    is exactly round_advance(r) − round_advance(r−1), the round period.
+    Negative legs are meaningful — they show pipeline overlap (e.g. a
+    parent quorum completing before our own certificate assembled).
+
+    The independent cross-check is the ``primary.round_advance_seconds``
+    histogram (stamped by the Proposer, not the trace): the mean of the
+    telescoped per-round sums must agree with the histogram mean — a
+    >10% gap means the trace is under-joined or a stage is mis-stamped,
+    and is warned about loudly (bench gate material, not a run failure:
+    the histogram also covers boot/tail rounds the trace join drops).
+    """
+    legs: Dict[str, List[float]] = {
+        "advance_to_header_proposed": [],
+        **{f"{a}_to_{b}": [] for a, b in ROUND_LEGS},
+    }
+    periods: List[float] = []
+    hist_sum, hist_count = 0.0, 0
+    for snap in snapshots:
+        if not snap.get("enabled", True):
+            continue
+        h = (snap.get("histograms") or {}).get(
+            "primary.round_advance_seconds"
+        )
+        if h and h.get("count"):
+            hist_sum += h["sum"]
+            hist_count += h["count"]
+        entries: Dict[int, dict] = {}
+        for key, st in (snap.get("round_trace") or {}).items():
+            try:
+                entries[int(key)] = st
+            except (TypeError, ValueError):
+                continue
+        for r in sorted(entries):
+            st = entries[r]
+            prev = entries.get(r - 1)
+            if prev is None or "round_advance" not in prev:
+                continue  # no anchor for the leading leg (e.g. round 1)
+            if any(s not in st for s in ROUND_STAGES):
+                continue  # partial round (boot/tail) — can't telescope
+            legs["advance_to_header_proposed"].append(
+                st["header_proposed"] - prev["round_advance"]
+            )
+            for a, b in ROUND_LEGS:
+                legs[f"{a}_to_{b}"].append(st[b] - st[a])
+            periods.append(st["round_advance"] - prev["round_advance"])
+
+    out: dict = {"rounds_joined": len(periods)}
+    if periods:
+        out["round_stages_ms"] = {
+            name: round(1000 * sum(v) / len(v), 3)
+            for name, v in legs.items()
+            if v
+        }
+        out["round_period_ms"] = round(
+            1000 * sum(periods) / len(periods), 3
+        )
+        # Telescoping makes sum(legs) == period per round by construction;
+        # keep the redundant sum in the artifact as a self-check anyway.
+        out["stage_sum_ms"] = round(
+            1000 * sum(sum(v) for v in legs.values()) / len(periods), 3
+        )
+    if hist_count:
+        out["round_advance_hist_ms"] = round(
+            1000 * hist_sum / hist_count, 3
+        )
+        if periods:
+            measured = out["round_advance_hist_ms"]
+            if measured > 0:
+                gap = abs(out["stage_sum_ms"] - measured) / measured
+                out["stage_sum_vs_hist"] = round(gap, 4)
+                if gap > 0.10:
+                    print(
+                        "WARNING: round-cadence sub-stages sum to "
+                        f"{out['stage_sum_ms']:.1f} ms but the "
+                        "round_advance_seconds histogram measured "
+                        f"{measured:.1f} ms ({100 * gap:.1f}% apart) — "
+                        "the round trace is under-joined or a stage is "
+                        "mis-stamped",
+                        file=sys.stderr,
+                    )
+    return out
 
 
 # -- committee-wide timeline from scraped samples -----------------------------

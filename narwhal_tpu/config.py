@@ -185,6 +185,14 @@ class Parameters:
     # enough digests, or when max_header_delay passes.
     header_size: int = 1_000
     max_header_delay: int = 100
+    # Sui-style round-cadence floor: when > 0, a proposer holding a parent
+    # quorum proposes as soon as (a) min_header_delay has elapsed since its
+    # last header AND (b) it has ANY payload — instead of riding
+    # max_header_delay waiting for header_size bytes of digests.  Empty
+    # rounds still wait for max_header_delay (an idle committee must not
+    # spin headers at wire speed).  0 (the default) disables the fast
+    # cadence and keeps the reference behavior bit-for-bit.
+    min_header_delay: int = 0
     # Depth of garbage collection, in rounds.
     gc_depth: int = 50
     # Delay before retrying a sync request, and fan-out of the retry.
@@ -199,6 +207,7 @@ class Parameters:
         (reference config/src/lib.rs:100-110, benchmark logs.py:109-131)."""
         logger.info("Header size set to %s B", self.header_size)
         logger.info("Max header delay set to %s ms", self.max_header_delay)
+        logger.info("Min header delay set to %s ms", self.min_header_delay)
         logger.info("Garbage collection depth set to %s rounds", self.gc_depth)
         logger.info("Sync retry delay set to %s ms", self.sync_retry_delay)
         logger.info("Sync retry nodes set to %s nodes", self.sync_retry_nodes)
@@ -209,6 +218,7 @@ class Parameters:
         return {
             "header_size": self.header_size,
             "max_header_delay": self.max_header_delay,
+            "min_header_delay": self.min_header_delay,
             "gc_depth": self.gc_depth,
             "sync_retry_delay": self.sync_retry_delay,
             "sync_retry_nodes": self.sync_retry_nodes,

@@ -92,6 +92,37 @@ STAGES: Tuple[str, ...] = (
     "commit",             # consensus: committed certificate delivered
 )
 
+# Round-cadence sub-stages, in causal order.  The r09 cert→commit
+# attribution showed 97-98% of commit latency is protocol cadence —
+# `primary.round_advance_seconds` × commit depth — so the round period
+# itself needs the same decomposition cert→commit got.  Each PRIMARY
+# stamps these into a second, per-ROUND trace table (key = the decimal
+# round number, one entry per round of its own header lifecycle):
+#
+#   header_proposed   proposer minted our round-r header
+#   header_broadcast  core handed the header to the reliable sender
+#   first_vote        first vote (incl. our own) for our round-r header
+#   vote_quorum       2f+1 vote stake reached — our certificate assembled
+#   cert_broadcast    our certificate handed to the reliable sender
+#   parent_quorum     2f+1 certificate stake for round r — parents ready
+#   round_advance     proposer moved to round r+1
+#
+# Unlike STAGES (joined committee-wide by digest), these are PER-NODE:
+# every primary runs its own cadence loop, so the bench aggregates legs
+# across (node, round) pairs without cross-node joining.  The leg from
+# round r-1's round_advance to round r's header_proposed (the proposer's
+# min/max-header-delay wait) is derived at analysis time, which makes the
+# legs telescope to exactly the measured round period.
+ROUND_STAGES: Tuple[str, ...] = (
+    "header_proposed",
+    "header_broadcast",
+    "first_vote",
+    "vote_quorum",
+    "cert_broadcast",
+    "parent_quorum",
+    "round_advance",
+)
+
 
 class Counter:
     """Monotone counter.  ``inc`` is the hot-path primitive: one add."""
@@ -162,21 +193,27 @@ class Histogram:
 
 
 class TraceTable:
-    """Bounded digest → {stage: timestamp} table (plus per-digest extras
-    like the sealed byte count).
+    """Bounded key → {stage: timestamp} table (plus per-key extras like
+    the sealed byte count).  Two instances exist per registry: the
+    per-digest pipeline trace (``stages=STAGES``, keys are digest hex)
+    and the per-round cadence trace (``stages=ROUND_STAGES``, keys are
+    decimal round numbers).
 
-    ``mark`` keeps the FIRST timestamp per (digest, stage) — matching the
+    ``mark`` keeps the FIRST timestamp per (key, stage) — matching the
     log parser's earliest-across-nodes convention — and evicts the oldest
-    digests FIFO once ``cap`` is exceeded, so a long-lived node's table
+    keys FIFO once ``cap`` is exceeded, so a long-lived node's table
     stays bounded.  Timestamps are wall-clock (``time.time()``): the bench
     joins stages across *processes* on the same host, which monotonic
     clocks cannot do.
     """
 
-    __slots__ = ("cap", "entries", "evictions")
+    __slots__ = ("cap", "entries", "evictions", "stages")
 
-    def __init__(self, cap: int = 32_768) -> None:
+    def __init__(
+        self, cap: int = 32_768, stages: Tuple[str, ...] = STAGES
+    ) -> None:
         self.cap = cap
+        self.stages = stages
         self.entries: Dict[str, Dict[str, float]] = {}
         # Evictions past the cap: each one is a digest the bench-side
         # stage join will silently miss, so the count is exported (see
@@ -187,7 +224,7 @@ class TraceTable:
     def mark(
         self, digest_hex: str, stage: str, ts: Optional[float] = None, **extra
     ) -> None:
-        if stage not in STAGES:
+        if stage not in self.stages:
             raise ValueError(f"unknown pipeline stage {stage!r}")
         entry = self.entries.get(digest_hex)
         if entry is None:
@@ -217,6 +254,7 @@ class _Null:
     cap = 0
     entries: Dict[str, Dict[str, float]] = {}
     evictions = 0
+    stages: Tuple[str, ...] = ()
 
     def inc(self, n=1) -> None: ...
     def dec(self, n=1) -> None: ...
@@ -250,6 +288,15 @@ class Registry:
         self.detail_fns: Dict[str, Callable[[], object]] = {}
         self.trace: TraceTable = (
             TraceTable(trace_cap) if enabled else _NULL  # type: ignore
+        )
+        # Per-round cadence trace (ROUND_STAGES): one entry per round the
+        # local primary's header lifecycle passes through.  Bounded much
+        # tighter than the digest trace — rounds arrive at ~10/s, so 4096
+        # covers runs far longer than any bench window.
+        self.round_trace: TraceTable = (
+            TraceTable(4096, stages=ROUND_STAGES)
+            if enabled
+            else _NULL  # type: ignore
         )
         # Attached HealthMonitor (node/main.py wires one per process);
         # snapshots then carry a `health` section and the MetricsServer
@@ -315,6 +362,8 @@ class Registry:
         if self.enabled:
             self.trace.entries.clear()
             self.trace.evictions = 0
+            self.round_trace.entries.clear()
+            self.round_trace.evictions = 0
         # A monitor attached by a previous test would otherwise keep
         # reporting rule state over the zeroed instruments.
         self.health = None
@@ -364,6 +413,14 @@ class Registry:
             "detail": {n: call(n, fn) for n, fn in self.detail_fns.items()},
             "trace": (
                 dict(self.trace.entries)
+                if self.enabled and include_trace
+                else {}
+            ),
+            # Small (one entry per round, not per digest) but gated with
+            # the digest trace anyway: the bench attribution reads the
+            # final cancellation flush, which always includes it.
+            "round_trace": (
+                dict(self.round_trace.entries)
                 if self.enabled and include_trace
                 else {}
             ),
@@ -887,6 +944,10 @@ def detail_fn(name: str, fn: Callable[[], object]) -> None:
 
 def trace() -> TraceTable:
     return _REGISTRY.trace  # type: ignore[return-value]
+
+
+def round_trace() -> TraceTable:
+    return _REGISTRY.round_trace  # type: ignore[return-value]
 
 
 # -- snapshot writer ----------------------------------------------------------

@@ -109,8 +109,10 @@ class Primary:
         tx_certs_sync = q()  # synchronizer → certificate waiter
         tx_headers_loopback = q()  # header waiter → core
         tx_certs_loopback = q()  # certificate waiter → core
-        tx_proposer = q()  # core → proposer (parents, round)
         tx_own_headers = q()  # proposer → core
+        # NOTE: no core → proposer queue anymore — parents are delivered
+        # via Proposer.deliver_parents, a synchronous same-loop callback
+        # (skips the queue round-trip on the round-cadence critical path).
 
         # Queue-depth gauges, polled only at snapshot/scrape time.
         for gname, gq in (
@@ -120,7 +122,6 @@ class Primary:
             ("primary.queue.others_digests", rx_others_digests),
             ("primary.queue.header_waiter", tx_headers_loopback),
             ("primary.queue.cert_waiter", tx_certs_loopback),
-            ("primary.queue.proposer", tx_proposer),
             ("primary.queue.own_headers", tx_own_headers),
             ("primary.queue.consensus", tx_consensus),
         ):
@@ -149,6 +150,20 @@ class Primary:
             )
         )
 
+        # The Proposer is built first so the Core can hand it parent
+        # quorums directly (deliver_parents) instead of through a queue.
+        proposer = Proposer(
+            name,
+            committee,
+            signature_service,
+            parameters.header_size,
+            parameters.max_header_delay,
+            rx_core=None,  # parents arrive via deliver_parents
+            rx_workers=rx_our_digests,
+            tx_core=tx_own_headers,
+            benchmark=benchmark,
+            min_header_delay_ms=parameters.min_header_delay,
+        )
         core = Core(
             name,
             committee,
@@ -162,7 +177,7 @@ class Primary:
             rx_certificate_waiter=tx_certs_loopback,
             rx_proposer=tx_own_headers,
             tx_consensus=tx_consensus,
-            tx_proposer=tx_proposer,
+            parents_cb=proposer.deliver_parents,
         )
         garbage_collector = GarbageCollector(
             name, committee, consensus_round, rx_consensus
@@ -185,17 +200,6 @@ class Primary:
             parameters.gc_depth,
             rx_synchronizer=tx_certs_sync,
             tx_core=tx_certs_loopback,
-        )
-        proposer = Proposer(
-            name,
-            committee,
-            signature_service,
-            parameters.header_size,
-            parameters.max_header_delay,
-            rx_core=tx_proposer,
-            rx_workers=rx_our_digests,
-            tx_core=tx_own_headers,
-            benchmark=benchmark,
         )
         helper = Helper(committee, store, tx_helper)
 

@@ -4,6 +4,21 @@ Reference primary/src/proposer.rs (155 LoC): starts at round 1 with genesis
 parents; creates a header whenever it has parents AND (payload ≥ header_size
 OR max_header_delay elapsed); round advances when the Core delivers a quorum
 of certificates for the current round.
+
+Two cadence extensions beyond the reference (ISSUE r10):
+
+- **min_header_delay** (Sui-style): when > 0, a parent quorum plus ANY
+  payload proposes as soon as min_header_delay has elapsed since the last
+  header, instead of riding max_header_delay waiting for header_size bytes
+  of digests.  Empty rounds still wait for the max delay, so an idle
+  committee does not spin headers at wire speed.  0 disables the knob and
+  keeps reference behavior exactly.
+- **direct parent delivery**: the Core calls :meth:`deliver_parents`
+  synchronously when the certificate quorum forms, instead of a queue
+  put → event-loop wakeup → queue get round-trip.  The round advances (and
+  ``primary.round_advance_seconds`` observes) at quorum time; a wake event
+  nudges the run loop to mint the next header.  The queue path (rx_core)
+  is kept for harnesses that wire the Proposer standalone.
 """
 
 from __future__ import annotations
@@ -32,15 +47,29 @@ class Proposer:
         signature_service: SignatureService,
         header_size: int,
         max_header_delay_ms: int,
-        rx_core: asyncio.Queue,  # (parent digests, round)
+        rx_core: Optional[asyncio.Queue],  # (parent digests, round); None
+        # when parents arrive solely via deliver_parents (Primary wiring)
         rx_workers: asyncio.Queue,  # (digest, worker_id)
         tx_core: asyncio.Queue,  # Header
         benchmark: bool = False,
+        min_header_delay_ms: int = 0,
     ) -> None:
         self.name = name
         self.signature_service = signature_service
         self.header_size = header_size
         self.max_header_delay = max_header_delay_ms / 1000.0
+        # min is a FLOOR under the max deadline; a min above the max would
+        # make payload rounds cycle slower than empty ones (which still
+        # mint at the max) — clamp loudly instead.
+        if min_header_delay_ms / 1000.0 > self.max_header_delay:
+            log.warning(
+                "min_header_delay (%d ms) exceeds max_header_delay "
+                "(%d ms); clamping to the max",
+                min_header_delay_ms, max_header_delay_ms,
+            )
+        self.min_header_delay = min(
+            min_header_delay_ms / 1000.0, self.max_header_delay
+        )
         self.rx_core = rx_core
         self.rx_workers = rx_workers
         self.tx_core = tx_core
@@ -50,6 +79,9 @@ class Proposer:
         self.last_parents: List[Digest] = [c.digest() for c in genesis(committee)]
         self.digests: List[Tuple[Digest, WorkerId]] = []
         self.payload_size = 0
+        # Set by deliver_parents (the Core's direct, queue-skipping path)
+        # to nudge the run loop out of its queue wait.
+        self._wake = asyncio.Event()
         self._m_headers = metrics.counter("primary.headers_proposed")
         self._m_payload_digests = metrics.counter("primary.payload_digests")
         self._m_round = metrics.gauge("primary.round")
@@ -59,12 +91,40 @@ class Proposer:
         # denominator (cert_inserted→commit_trigger ≈ commit depth ×
         # this), so a slow commit path reads directly as either a slow
         # round period (look here) or a starved commit rule (look at
-        # consensus.commit_lag_rounds).
+        # consensus.commit_lag_rounds).  The per-round sub-stage trace
+        # (metrics.ROUND_STAGES) decomposes it.
         self._m_round_advance = metrics.histogram(
             "primary.round_advance_seconds"
         )
         self._last_advance: Optional[float] = None
         self._mtrace = metrics.trace()
+        self._rtrace = metrics.round_trace()
+
+    def deliver_parents(self, parents: List[Digest], round: Round) -> None:
+        """Direct (same-event-loop, synchronous) parent delivery from the
+        Core: the round advances HERE, at certificate-quorum time, and the
+        run loop is woken to mint the next header — no queue round-trip on
+        the cadence critical path."""
+        self._advance(parents, round)
+        self._wake.set()
+
+    def _advance(self, parents: List[Digest], round: Round) -> bool:
+        """Apply a parent quorum for ``round``; returns True if the round
+        advanced.  Observes ``round_advance_seconds`` exactly once per
+        advance (stale re-deliveries for old rounds are dropped)."""
+        if round < self.round:
+            return False
+        self.round = round + 1
+        self._m_round.set(self.round)
+        now = asyncio.get_running_loop().time()
+        if self._last_advance is not None:
+            self._m_round_advance.observe(now - self._last_advance)
+        self._last_advance = now
+        # Round-cadence trace: round `round`'s lifecycle ends here.
+        self._rtrace.mark(str(round), "round_advance")
+        log.debug("Dag moved to round %d", self.round)
+        self.last_parents = parents
+        return True
 
     async def _make_header(self) -> None:
         payload = dict(self.digests)
@@ -76,6 +136,7 @@ class Proposer:
         log.debug("Created %r", header)
         self._m_headers.inc()
         self._m_payload_digests.inc(len(payload))
+        self._rtrace.mark(str(header.round), "header_proposed")
         for digest in payload:
             self._mtrace.mark(bytes(digest).hex(), "header")
         if self.benchmark:
@@ -89,43 +150,62 @@ class Proposer:
         log.debug("Dag starting at round %d", self.round)
         loop = asyncio.get_running_loop()
         deadline = loop.time() + self.max_header_delay
-        core_get = loop.create_task(self.rx_core.get())
+        min_deadline = loop.time()  # min delay trivially elapsed at boot
+        core_get = (
+            loop.create_task(self.rx_core.get())
+            if self.rx_core is not None
+            else None
+        )
         workers_get = loop.create_task(self.rx_workers.get())
+        wake_get = loop.create_task(self._wake.wait())
         try:
             while True:
-                timer_expired = loop.time() >= deadline
+                now = loop.time()
+                timer_expired = now >= deadline
+                min_expired = now >= min_deadline
                 enough_digests = self.payload_size >= self.header_size
-                if (timer_expired or enough_digests) and self.last_parents:
+                # "Ready" payload: a full header, or — with the min-delay
+                # cadence enabled — any payload at all.
+                ready = enough_digests or (
+                    self.min_header_delay > 0 and bool(self.digests)
+                )
+                if self.last_parents and (
+                    timer_expired or (min_expired and ready)
+                ):
                     await self._make_header()
                     self.payload_size = 0
-                    deadline = loop.time() + self.max_header_delay
+                    now = loop.time()
+                    deadline = now + self.max_header_delay
+                    min_deadline = now + self.min_header_delay
 
-                # With no parent quorum the timer is irrelevant (we cannot
+                # With no parent quorum the timers are irrelevant (we cannot
                 # propose anyway) — wait purely on the queues instead of
-                # busy-spinning on an already-expired deadline.
-                timeout = (
-                    max(0.0, deadline - loop.time()) if self.last_parents else None
-                )
+                # busy-spinning on an already-expired deadline.  With
+                # parents, wait only until the deadline that can actually
+                # trigger: the min one if payload is ready, else the max.
+                if not self.last_parents:
+                    timeout = None
+                elif ready:
+                    timeout = max(0.0, min_deadline - now)
+                else:
+                    timeout = max(0.0, deadline - now)
+                waits = {workers_get, wake_get}
+                if core_get is not None:
+                    waits.add(core_get)
                 done, _ = await asyncio.wait(
-                    {core_get, workers_get},
+                    waits,
                     timeout=timeout,
                     return_when=asyncio.FIRST_COMPLETED,
                 )
-                if core_get in done:
+                if wake_get in done:
+                    # deliver_parents already advanced the round; just
+                    # rearm the event and fall through to the mint check.
+                    self._wake.clear()
+                    wake_get = loop.create_task(self._wake.wait())
+                if core_get is not None and core_get in done:
                     parents, round = core_get.result()
                     core_get = loop.create_task(self.rx_core.get())
-                    if round >= self.round:
-                        # Advance to the next round.
-                        self.round = round + 1
-                        self._m_round.set(self.round)
-                        now = loop.time()
-                        if self._last_advance is not None:
-                            self._m_round_advance.observe(
-                                now - self._last_advance
-                            )
-                        self._last_advance = now
-                        log.debug("Dag moved to round %d", self.round)
-                        self.last_parents = parents
+                    self._advance(parents, round)
                 if workers_get in done:
                     digest, worker_id = workers_get.result()
                     workers_get = loop.create_task(self.rx_workers.get())
@@ -135,5 +215,7 @@ class Proposer:
                     self.payload_size += len(digest)
                     self.digests.append((digest, worker_id))
         finally:
-            core_get.cancel()
+            if core_get is not None:
+                core_get.cancel()
             workers_get.cancel()
+            wake_get.cancel()

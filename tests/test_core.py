@@ -384,3 +384,236 @@ def test_certificate_waiter_parks_until_parents_stored(run):
         task.cancel()
 
     run(go())
+
+
+# --- round-cadence fast path (ISSUE r10) -------------------------------------
+
+
+def test_gc_sweep_per_burst_shrinks_round_maps(run):
+    """The GC sweep is hoisted to once per drained burst (no longer per
+    message), and per-round maps must still shrink once the shared
+    consensus round moves past the GC window."""
+
+    async def go():
+        c = committee()
+        me = keys()[0]
+        core, store, qs = make_core(c, me)
+        # Populate per-round state well below the future GC round.
+        for r in range(1, 6):
+            core.last_voted.setdefault(r, set()).add(me.name)
+            core.processing.setdefault(r, set()).add(digest32(bytes([r])))
+            core.cancel_handlers.setdefault(r, []).append(
+                asyncio.get_running_loop().create_future()
+            )
+        task = asyncio.ensure_future(core.run())
+        core.consensus_round.value = 60  # gc_depth=50 -> gc_round=10
+        # Any burst triggers the sweep; a stale header is enough.
+        await qs["primaries"].put(("header", make_header(keys()[1], c=c)))
+        for _ in range(100):
+            if core.gc_round == 10:
+                break
+            await asyncio.sleep(0.02)
+        assert core.gc_round == 10
+        assert not core.last_voted and not core.processing
+        assert not core.cancel_handlers
+        task.cancel()
+        core.network.close()
+
+    run(go())
+
+
+def test_vote_fast_path_coalesces_header_persists(run):
+    """A drained burst of N valid headers: every vote still goes out and
+    every header is durably logged, but the log append happens ONCE for
+    the whole burst (one writev), after which the staged votes are
+    released — persist-before-vote, coalesced per burst."""
+
+    async def go():
+        import os as _os
+        import tempfile
+
+        from narwhal_tpu.store import Store as _Store
+
+        c = committee(base_port=13500)
+        me = keys()[0]
+        authors = keys()[1:4]
+        # File-backed: the deferred/coalesced log path only exists with a
+        # log fd (memory-only stores have nothing to defer).
+        tmpdir = tempfile.mkdtemp(prefix="core_fastpath_")
+        store = _Store(_os.path.join(tmpdir, "store.log"))
+        core, store, qs = make_core(c, me, store=store)
+        assert core.fast_path  # default arm
+
+        flushes = []
+        real_flush = store.flush_deferred
+
+        def counting_flush():
+            if store._pending:
+                flushes.append(len(store._pending) // 3)  # records pending
+            real_flush()
+
+        store.flush_deferred = counting_flush
+
+        listeners = []
+        for kp in authors:
+            h = RecordingAckHandler()
+            listeners.append(
+                (h, await Receiver.spawn(
+                    c.primary(kp.name).primary_to_primary, h
+                ))
+            )
+        # Queue the whole burst BEFORE the core runs, so one drain sees
+        # all three headers.
+        for kp in authors:
+            await qs["primaries"].put(("header", make_header(kp, c=c)))
+        task = asyncio.ensure_future(core.run())
+        for h, _ in listeners:
+            await asyncio.wait_for(h.arrived.wait(), 10)
+            kind, vote = decode_primary_message(h.received[0])
+            assert kind == "vote" and vote.author == me.name
+        # All three headers buffered into ONE coalesced flush, and every
+        # record durably logged (persist-before-vote preserved).
+        assert flushes and flushes[0] == 3, flushes
+        store.close()
+        replayed = _Store(_os.path.join(tmpdir, "store.log"))
+        for kp in authors:
+            assert replayed.read(bytes(make_header(kp, c=c).id)) is not None
+        replayed.close()
+        task.cancel()
+        core.network.close()
+        for _, recv in listeners:
+            await recv.shutdown()
+
+    run(go())
+
+
+def test_legacy_arm_persists_and_votes_per_header(run):
+    """fast_path=False (the bench_cadence A/B legacy arm) keeps the
+    per-header persist + immediate vote send."""
+
+    async def go():
+        c = committee(base_port=13600)
+        me, author = keys()[0], keys()[1]
+        core, store, qs = make_core(c, me)
+        core.fast_path = False
+        author_handler = RecordingAckHandler()
+        recv = await Receiver.spawn(
+            c.primary(author.name).primary_to_primary, author_handler
+        )
+        task = asyncio.ensure_future(core.run())
+        header = make_header(author, c=c)
+        await qs["primaries"].put(("header", header))
+        await asyncio.wait_for(author_handler.arrived.wait(), 10)
+        kind, vote = decode_primary_message(author_handler.received[0])
+        assert kind == "vote" and vote.id == header.id
+        assert store.read(bytes(header.id)) is not None
+        task.cancel()
+        core.network.close()
+        await recv.shutdown()
+
+    run(go())
+
+
+def test_parent_quorum_delivered_via_direct_callback(run):
+    """With parents_cb wired (the Primary's default), a certificate
+    quorum invokes the callback synchronously instead of the queue."""
+
+    async def go():
+        c = committee()
+        me = keys()[0]
+        core, store, qs = make_core(c, me)
+        delivered = []
+        core.parents_cb = lambda parents, round: delivered.append(
+            (sorted(parents), round)
+        )
+        task = asyncio.ensure_future(core.run())
+        certs = [make_certificate(make_header(kp, c=c)) for kp in keys()[:3]]
+        for cert in certs:
+            await qs["primaries"].put(("certificate", cert))
+        got = [await asyncio.wait_for(qs["consensus"].get(), 5) for _ in range(3)]
+        assert [g.digest() for g in got] == [x.digest() for x in certs]
+        assert delivered == [
+            (sorted(x.digest() for x in certs), 1)
+        ]
+        assert qs["proposer_out"].empty()  # queue path not used
+        task.cancel()
+        core.network.close()
+
+    run(go())
+
+
+def test_round_trace_stamped_through_header_vote_cert_cycle(run):
+    """One full own-header cycle stamps the round-cadence sub-stages the
+    bench attribution joins: header_broadcast, first_vote, vote_quorum,
+    cert_broadcast, parent_quorum."""
+
+    async def go():
+        from narwhal_tpu import metrics
+
+        metrics.round_trace().entries.clear()
+        c = committee(base_port=13700)
+        me = keys()[0]
+        core, store, qs = make_core(c, me)
+        listeners = []
+        for _, addrs in c.others_primaries(me.name):
+            h = RecordingAckHandler()
+            listeners.append(
+                (h, await Receiver.spawn(addrs.primary_to_primary, h))
+            )
+        task = asyncio.ensure_future(core.run())
+
+        header = make_header(me, c=c)
+        await qs["proposer_in"].put(header)  # own proposal path
+        # The core must adopt the header before its votes are valid
+        # (sanitize_vote rejects votes for a foreign current_header).
+        for _ in range(200):
+            if core.current_header is header:
+                break
+            await asyncio.sleep(0.02)
+        assert core.current_header is header
+        for vote in make_votes(header):
+            await qs["primaries"].put(("vote", vote))
+        for kp in keys()[1:4]:
+            await qs["primaries"].put(
+                ("certificate", make_certificate(make_header(kp, c=c)))
+            )
+        # Own cert + two others complete the round-1 parent quorum.
+        for _ in range(200):
+            if "parent_quorum" in metrics.round_trace().entries.get("1", {}):
+                break
+            await asyncio.sleep(0.02)
+        entry = metrics.round_trace().entries.get("1", {})
+        for stage in (
+            "header_broadcast", "first_vote", "vote_quorum",
+            "cert_broadcast", "parent_quorum",
+        ):
+            assert stage in entry, (stage, entry)
+        task.cancel()
+        core.network.close()
+        for _, recv in listeners:
+            await recv.shutdown()
+
+    run(go())
+
+
+def test_core_requires_a_parent_quorum_sink():
+    """Neither parents_cb nor tx_proposer: fail at construction, not by
+    silently discarding every parent quorum at runtime."""
+    import pytest
+
+    from narwhal_tpu.crypto import SignatureService as _SS
+    from narwhal_tpu.store import Store as _Store
+
+    c = committee()
+    me = keys()[0]
+    store = _Store()
+    qs = [asyncio.Queue() for _ in range(6)]
+    with pytest.raises(ValueError, match="parent-quorum sink"):
+        Core(
+            me.name, c, store,
+            Synchronizer(me.name, c, store, qs[0], qs[1]),
+            _SS(me), AtomicRound(), gc_depth=50,
+            rx_primaries=qs[2], rx_header_waiter=qs[3],
+            rx_certificate_waiter=qs[4], rx_proposer=qs[5],
+            tx_consensus=asyncio.Queue(),
+        )

@@ -1,0 +1,78 @@
+"""benchmark/logs_merge.py: k-way merge of per-node --log-json streams
+into one time-sorted committee-wide JSONL (ISSUE r10 satellite — the
+ROADMAP's remaining observability follow-up)."""
+
+import io
+import json
+
+from benchmark.logs_merge import merge_streams
+
+
+def lines(*records):
+    return [json.dumps(r) for r in records]
+
+
+def merged(named_texts):
+    out = io.StringIO()
+    n = merge_streams(named_texts, out)
+    recs = [json.loads(ln) for ln in out.getvalue().splitlines()]
+    assert n == len(recs)
+    return recs
+
+
+def test_merge_is_time_sorted_and_node_tagged():
+    a = lines(
+        {"ts": 1.0, "level": "INFO", "msg": "a1", "node": "primary-0"},
+        {"ts": 3.0, "level": "INFO", "msg": "a2", "node": "primary-0"},
+    )
+    b = lines(
+        {"ts": 2.0, "level": "INFO", "msg": "b1", "node": "worker-0-0"},
+        {"ts": 4.0, "level": "WARNING", "msg": "b2", "node": "worker-0-0"},
+    )
+    recs = merged([("primary-0.log", a), ("worker-0-0.log", b)])
+    assert [r["msg"] for r in recs] == ["a1", "b1", "a2", "b2"]
+    assert [r["node"] for r in recs] == [
+        "primary-0", "worker-0-0", "primary-0", "worker-0-0",
+    ]
+    assert [r["ts"] for r in recs] == sorted(r["ts"] for r in recs)
+
+
+def test_missing_node_tag_falls_back_to_filename_stem():
+    a = lines({"ts": 1.0, "level": "INFO", "msg": "untagged"})
+    recs = merged([("/tmp/bench/primary-3.log", a)])
+    assert recs[0]["node"] == "primary-3"
+
+
+def test_non_json_lines_are_wrapped_not_dropped():
+    a = [
+        json.dumps({"ts": 10.0, "level": "INFO", "msg": "ok", "node": "n0"}),
+        "Traceback (most recent call last):",
+        '  raise RuntimeError("boom")',
+        json.dumps({"ts": 12.0, "level": "ERROR", "msg": "after", "node": "n0"}),
+    ]
+    b = lines({"ts": 11.0, "level": "INFO", "msg": "other", "node": "n1"})
+    recs = merged([("n0.log", a), ("n1.log", b)])
+    # Every input line survives the merge.
+    assert len(recs) == 5
+    raw = [r for r in recs if r["level"] == "RAW"]
+    assert len(raw) == 2 and raw[0]["msg"].startswith("Traceback")
+    # Raw lines inherit the last seen timestamp, so they sort adjacent to
+    # their context (after "ok" at 10.0, before "other" at 11.0).
+    order = [r["msg"] for r in recs]
+    assert order.index("ok") < order.index(raw[0]["msg"]) < order.index("other")
+
+
+def test_same_timestamp_keeps_within_file_order():
+    a = lines(
+        {"ts": 5.0, "msg": "first", "node": "n0"},
+        {"ts": 5.0, "msg": "second", "node": "n0"},
+        {"ts": 5.0, "msg": "third", "node": "n0"},
+    )
+    recs = merged([("n0.log", a)])
+    assert [r["msg"] for r in recs] == ["first", "second", "third"]
+
+
+def test_empty_and_blank_streams():
+    recs = merged([("n0.log", []), ("n1.log", ["", "  "])])
+    # Blank lines are skipped; whitespace-only lines wrap as RAW.
+    assert [r["level"] for r in recs] == ["RAW"]

@@ -364,3 +364,155 @@ def test_cross_validate_agreement_and_failure():
     r2 = ParseResult(committed_bytes=200000)
     cross_validate(r2, [worker, primary], tx_size=512)
     assert any("cross-check FAILED" in e for e in r2.errors)
+
+
+# --- round-cadence trace + attribution (ISSUE r10) ---------------------------
+
+
+def test_round_trace_table_semantics():
+    """The per-round cadence trace: validates ROUND_STAGES names (digest
+    stages are rejected), appears in snapshots under round_trace, and
+    resets with the registry."""
+    reg = Registry()
+    reg.round_trace.mark("3", "header_proposed", ts=1.0)
+    reg.round_trace.mark("3", "round_advance", ts=2.0)
+    with pytest.raises(ValueError):
+        reg.round_trace.mark("3", "seal")  # digest stage, wrong table
+    with pytest.raises(ValueError):
+        reg.trace.mark("d1", "header_proposed")  # round stage, wrong table
+    snap = reg.snapshot()
+    assert snap["round_trace"]["3"] == {
+        "header_proposed": 1.0, "round_advance": 2.0,
+    }
+    assert reg.snapshot(include_trace=False)["round_trace"] == {}
+    reg.reset()
+    assert reg.round_trace.entries == {}
+
+
+def test_round_attribution_telescopes_to_round_period(capsys):
+    """round_attribution: legs (including the derived advance→proposed
+    wait) telescope to exactly the per-round period, aggregate across
+    nodes without cross-node joins, and cross-check against the
+    round_advance_seconds histogram."""
+    from benchmark.metrics_check import round_attribution
+
+    def entry(base, scale=1.0):
+        # One round's stages: proposed at +0, broadcast +10ms, first vote
+        # +20ms, quorum +40ms, cert bcast +45ms, parent quorum +70ms,
+        # advance +75ms.
+        offs = {
+            "header_proposed": 0.0, "header_broadcast": 0.010,
+            "first_vote": 0.020, "vote_quorum": 0.040,
+            "cert_broadcast": 0.045, "parent_quorum": 0.070,
+            "round_advance": 0.075,
+        }
+        return {k: base + scale * v for k, v in offs.items()}
+
+    # Node A: rounds 1-3, 100 ms apart (so the advance->proposed wait is
+    # 25 ms); node B: same shape shifted — legs must NOT join across
+    # nodes (a cross-node join would corrupt every leg).
+    snap_a = {
+        "enabled": True,
+        "round_trace": {"1": entry(0.0), "2": entry(0.1), "3": entry(0.2)},
+        "histograms": {
+            "primary.round_advance_seconds": {"count": 2, "sum": 0.2}
+        },
+    }
+    snap_b = {
+        "enabled": True,
+        "round_trace": {"1": entry(50.0), "2": entry(50.1)},
+        "histograms": {
+            "primary.round_advance_seconds": {"count": 1, "sum": 0.1}
+        },
+    }
+    out = round_attribution([snap_a, snap_b])
+    # Rounds 2,3 on A + round 2 on B (round 1 has no previous advance).
+    assert out["rounds_joined"] == 3
+    legs = out["round_stages_ms"]
+    assert math.isclose(legs["advance_to_header_proposed"], 25.0, abs_tol=0.01)
+    assert math.isclose(legs["header_proposed_to_header_broadcast"], 10.0, abs_tol=0.01)
+    assert math.isclose(legs["first_vote_to_vote_quorum"], 20.0, abs_tol=0.01)
+    assert math.isclose(legs["parent_quorum_to_round_advance"], 5.0, abs_tol=0.01)
+    # Telescoping: legs sum to the measured 100 ms round period, which
+    # agrees with the histogram (no warning).
+    assert math.isclose(out["round_period_ms"], 100.0, abs_tol=0.01)
+    assert math.isclose(out["stage_sum_ms"], 100.0, abs_tol=0.01)
+    assert math.isclose(out["round_advance_hist_ms"], 100.0, abs_tol=0.01)
+    assert out["stage_sum_vs_hist"] < 0.10
+    assert "WARNING" not in capsys.readouterr().err
+
+    # A >10% gap between the stage sum and the histogram warns loudly.
+    snap_bad = dict(snap_a)
+    snap_bad["histograms"] = {
+        "primary.round_advance_seconds": {"count": 2, "sum": 0.4}
+    }
+    out_bad = round_attribution([snap_bad])
+    assert out_bad["stage_sum_vs_hist"] > 0.10
+    assert "round-cadence sub-stages" in capsys.readouterr().err
+
+
+def test_round_attribution_partial_rounds_skipped():
+    """Boot/tail rounds missing stages (or the previous round's advance
+    anchor) are dropped, never fabricated."""
+    from benchmark.metrics_check import round_attribution
+
+    snap = {
+        "enabled": True,
+        "round_trace": {
+            "1": {"header_proposed": 0.0, "round_advance": 0.075},
+            # round 2 is complete but round 1 is partial -> still usable
+            # (only the PREVIOUS round_advance is needed as anchor).
+            "2": {
+                "header_proposed": 0.1, "header_broadcast": 0.11,
+                "first_vote": 0.12, "vote_quorum": 0.14,
+                "cert_broadcast": 0.145, "parent_quorum": 0.17,
+                "round_advance": 0.175,
+            },
+            # round 4: no round 3 anchor -> dropped.
+            "4": {
+                "header_proposed": 0.3, "header_broadcast": 0.31,
+                "first_vote": 0.32, "vote_quorum": 0.34,
+                "cert_broadcast": 0.345, "parent_quorum": 0.37,
+                "round_advance": 0.375,
+            },
+            "not-a-round": {"header_proposed": 9.9},
+        },
+    }
+    out = round_attribution([snap])
+    assert out["rounds_joined"] == 1
+    assert math.isclose(out["round_period_ms"], 100.0, abs_tol=0.01)
+
+
+def test_cross_validate_carries_round_attribution():
+    """cross_validate embeds the round attribution next to stages_ms and
+    fills ParseResult.round_stages_ms for the bench JSON."""
+    from benchmark.logs import ParseResult
+    from benchmark.metrics_check import cross_validate
+
+    snap = {
+        "enabled": True,
+        "trace": {},
+        "round_trace": {
+            "1": {
+                "header_proposed": 0.0, "header_broadcast": 0.01,
+                "first_vote": 0.02, "vote_quorum": 0.04,
+                "cert_broadcast": 0.045, "parent_quorum": 0.07,
+                "round_advance": 0.075,
+            },
+            "2": {
+                "header_proposed": 0.1, "header_broadcast": 0.11,
+                "first_vote": 0.12, "vote_quorum": 0.14,
+                "cert_broadcast": 0.145, "parent_quorum": 0.17,
+                "round_advance": 0.175,
+            },
+        },
+    }
+    r = ParseResult(committed_bytes=0)
+    summary = cross_validate(r, [snap], tx_size=512)
+    assert summary["round_attribution"]["rounds_joined"] == 1
+    assert math.isclose(
+        r.round_stages_ms["advance_to_header_proposed"], 25.0, abs_tol=0.01
+    )
+    assert math.isclose(
+        summary["round_attribution"]["round_period_ms"], 100.0, abs_tol=0.01
+    )

@@ -19,7 +19,7 @@ def run():
     return _run
 
 
-def make_proposer(c, kp, header_size=1_000, delay_ms=50):
+def make_proposer(c, kp, header_size=1_000, delay_ms=50, min_delay_ms=0):
     rx_core, rx_workers, tx_core = (
         asyncio.Queue(),
         asyncio.Queue(),
@@ -34,6 +34,7 @@ def make_proposer(c, kp, header_size=1_000, delay_ms=50):
         rx_core,
         rx_workers,
         tx_core,
+        min_header_delay_ms=min_delay_ms,
     )
     return p, rx_core, rx_workers, tx_core
 
@@ -89,5 +90,189 @@ def test_round_advance_requires_parents(run):
         second = await asyncio.wait_for(tx_core.get(), 5)
         assert second.round == 2 and second.parents == set(parents)
         task.cancel()
+
+    run(go())
+
+
+# --- round-cadence edges (ISSUE r10) -----------------------------------------
+
+
+def test_parents_after_expired_deadline_mint_immediately(run):
+    """Parents arriving AFTER max_header_delay already expired must mint
+    the next header right away, not re-arm a fresh full delay."""
+
+    async def go():
+        c = committee()
+        kp = keys()[0]
+        p, rx_core, _, tx_core = make_proposer(
+            c, kp, header_size=1_000, delay_ms=50
+        )
+        task = asyncio.ensure_future(p.run())
+        first = await asyncio.wait_for(tx_core.get(), 5)
+        assert first.round == 1
+        # Let the deadline expire several times over with no parents.
+        await asyncio.sleep(0.4)
+        assert tx_core.empty()
+        loop = asyncio.get_running_loop()
+        t0 = loop.time()
+        await rx_core.put(([digest32(bytes([i]) * 3) for i in range(3)], 1))
+        second = await asyncio.wait_for(tx_core.get(), 5)
+        # Immediate (empty-payload, expired timer): far less than a fresh
+        # 50 ms delay, with slack for a loaded host.
+        assert loop.time() - t0 < 2.0
+        assert second.round == 2
+        task.cancel()
+
+    run(go())
+
+
+def test_min_header_delay_proposes_partial_payload(run):
+    """With the min-delay cadence on, a parent quorum plus ANY payload
+    proposes after min_header_delay instead of riding max_header_delay
+    (here: effectively never) waiting for header_size bytes."""
+
+    async def go():
+        c = committee()
+        kp = keys()[0]
+        # max delay far beyond the test timeout: only the min-delay path
+        # can mint this header.
+        p, _, rx_workers, tx_core = make_proposer(
+            c, kp, header_size=1_000_000, delay_ms=60_000, min_delay_ms=10
+        )
+        task = asyncio.ensure_future(p.run())
+        digest = digest32(b"one small batch")
+        await rx_workers.put((digest, 0))
+        header = await asyncio.wait_for(tx_core.get(), 5)
+        assert header.round == 1 and header.payload == {digest: 0}
+        task.cancel()
+
+    run(go())
+
+
+def test_min_header_delay_empty_rounds_still_wait_max(run):
+    """Empty-payload rounds must NOT fire at the min cadence — an idle
+    committee rides max_header_delay exactly as before the knob."""
+
+    async def go():
+        c = committee()
+        kp = keys()[0]
+        p, _, _, tx_core = make_proposer(
+            c, kp, header_size=1_000, delay_ms=400, min_delay_ms=10
+        )
+        task = asyncio.ensure_future(p.run())
+        # Well past several min periods, still inside max: no header.
+        await asyncio.sleep(0.15)
+        assert tx_core.empty()
+        header = await asyncio.wait_for(tx_core.get(), 5)
+        assert header.round == 1 and header.payload == {}
+        task.cancel()
+
+    run(go())
+
+
+def test_min_header_delay_rate_limits_full_payload(run):
+    """min_header_delay is also the round-cadence floor: two consecutive
+    size-triggered headers must be at least min_header_delay apart."""
+
+    async def go():
+        c = committee()
+        kp = keys()[0]
+        p, rx_core, rx_workers, tx_core = make_proposer(
+            c, kp, header_size=16, delay_ms=60_000, min_delay_ms=200
+        )
+        task = asyncio.ensure_future(p.run())
+        loop = asyncio.get_running_loop()
+        await rx_workers.put((digest32(b"a"), 0))
+        first = await asyncio.wait_for(tx_core.get(), 5)
+        t1 = loop.time()
+        assert first.round == 1
+        # Round 2 payload + parents are ready almost immediately...
+        await rx_workers.put((digest32(b"b"), 0))
+        await rx_core.put(([digest32(bytes([i]) * 3) for i in range(3)], 1))
+        second = await asyncio.wait_for(tx_core.get(), 5)
+        # ...but the mint waits out the min delay.
+        assert loop.time() - t1 >= 0.15
+        assert second.round == 2
+        task.cancel()
+
+    run(go())
+
+
+def test_round_advance_observed_exactly_once_per_advance(run):
+    """primary.round_advance_seconds gets exactly one observation per
+    actual advance — duplicate or stale parent deliveries (queue path or
+    the direct deliver_parents callback) observe nothing."""
+
+    async def go():
+        from narwhal_tpu import metrics
+
+        c = committee()
+        kp = keys()[0]
+        p, rx_core, _, tx_core = make_proposer(c, kp, header_size=1_000, delay_ms=50)
+        hist = metrics.histogram("primary.round_advance_seconds")
+        base = hist.count
+        task = asyncio.ensure_future(p.run())
+        parents = [digest32(bytes([i]) * 3) for i in range(3)]
+
+        # First advance (1 -> 2): arms _last_advance, no period yet.
+        p.deliver_parents(parents, 1)
+        assert p.round == 2 and hist.count == base
+        # Second advance (2 -> 3): one observation.
+        p.deliver_parents(parents, 2)
+        assert p.round == 3 and hist.count == base + 1
+        # Stale and duplicate deliveries: no advance, no observation.
+        p.deliver_parents(parents, 2)
+        p.deliver_parents(parents, 1)
+        assert p.round == 3 and hist.count == base + 1
+        # The queue path shares the same dedupe.
+        await rx_core.put((parents, 2))
+        await asyncio.sleep(0.1)
+        assert p.round == 3 and hist.count == base + 1
+        await rx_core.put((parents, 3))
+        await asyncio.sleep(0.1)
+        assert p.round == 4 and hist.count == base + 2
+        task.cancel()
+
+    run(go())
+
+
+def test_deliver_parents_wakes_run_loop_and_stamps_round_trace(run):
+    """The Core's direct callback must wake the proposer out of its queue
+    wait (minting the next header without a queue round-trip) and stamp
+    the round-cadence trace (header_proposed + round_advance)."""
+
+    async def go():
+        from narwhal_tpu import metrics
+
+        c = committee()
+        kp = keys()[0]
+        p, _, _, tx_core = make_proposer(c, kp, header_size=1_000, delay_ms=50)
+        task = asyncio.ensure_future(p.run())
+        first = await asyncio.wait_for(tx_core.get(), 5)
+        assert first.round == 1
+        parents = [digest32(bytes([i]) * 3) for i in range(3)]
+        p.deliver_parents(parents, 1)
+        second = await asyncio.wait_for(tx_core.get(), 5)
+        assert second.round == 2 and second.parents == set(parents)
+        rt = metrics.round_trace().entries
+        assert "header_proposed" in rt.get("1", {})
+        assert "round_advance" in rt.get("1", {})
+        assert "header_proposed" in rt.get("2", {})
+        task.cancel()
+
+    run(go())
+
+
+def test_min_header_delay_clamped_to_max(run):
+    """min_header_delay above max_header_delay is incoherent (payload
+    rounds would cycle SLOWER than empty ones) — it clamps to the max."""
+
+    async def go():
+        c = committee()
+        kp = keys()[0]
+        p, _, _, _ = make_proposer(
+            c, kp, header_size=1_000, delay_ms=100, min_delay_ms=500
+        )
+        assert p.min_header_delay == p.max_header_delay == 0.1
 
     run(go())
