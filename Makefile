@@ -10,7 +10,7 @@
 
 PYTHON ?= python
 
-.PHONY: check native lint test test-ci metrics-smoke bench clean
+.PHONY: check native lint test test-ci metrics-smoke fault-smoke bench clean
 
 check: native lint test
 
@@ -47,6 +47,17 @@ metrics-smoke: native
 	JAX_PLATFORMS=cpu NARWHAL_METRICS_DUMP=.ci-artifacts \
 		$(PYTHON) -m pytest tests/test_metrics_pipeline.py -x -q
 	JAX_PLATFORMS=cpu $(PYTHON) benchmark/health_smoke.py
+
+# Fault-injection smoke: the two CI scenarios (one Byzantine, one
+# crash/restart) through the scenario runner, each gated on the three
+# machine-checked verdicts (safety/liveness/detection) plus the
+# zero-false-positive control arm.  Artifacts in .ci-artifacts/.
+fault-smoke:
+	mkdir -p .ci-artifacts
+	JAX_PLATFORMS=cpu $(PYTHON) benchmark/fault_bench.py \
+		--scenario benchmark/scenarios/byz_wrong_key.json \
+		--scenario benchmark/scenarios/crash_restart.json \
+		--artifact '.ci-artifacts/fault-{name}.json'
 
 # The crypto differential suite under the float32 lane dtype (the default
 # run covers int32 + a narrow f32 subprocess check; run this after any

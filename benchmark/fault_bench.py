@@ -1,0 +1,669 @@
+"""Fault-scenario runner: drive a local committee through a declared fault
+and emit one artifact with three machine-checked verdicts.
+
+    python benchmark/fault_bench.py --scenario benchmark/scenarios/byz_wrong_key.json \
+        --artifact artifacts/fault_byz_wrong_key.json
+
+Per scenario (narwhal_tpu/faults/spec.py) the runner launches a
+local_bench-style committee with the scenario's fault planes wired in
+(Byzantine plans via ``--fault-plan``/NARWHAL_FAULT_PLAN, WAN shaping via
+NARWHAL_FAULT_NETEM, crash/restart orchestrated from here with SIGKILL +
+respawn over the same store), scrapes every node throughout, and then
+judges:
+
+- **safety** — every honest node's consensus audit segments replayed
+  through the frozen golden oracle (consensus/replay.py): byte-identical
+  commit sequences, certificate-uniqueness and causal-history invariants,
+  and cross-node prefix consistency;
+- **liveness** — honest survivors keep committing client payload AFTER
+  the fault settles (scraped ``consensus.committed_batch_digests``
+  deltas; the same payload-progress gate local_bench uses);
+- **detection** — every rule in ``expect.rules`` FIRES into the timeline
+  ``events`` track, and (unless ``--skip-control``) a control arm with
+  all fault planes stripped fires NOTHING.
+
+The scenario clock starts when the committee is launched (netem's
+``start_ts`` anchor): crash/partition offsets must leave a few seconds of
+boot slack.  Exit code is non-zero if any verdict fails — the CI
+fault-smoke gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import datetime
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from narwhal_tpu.config import Parameters, export_keypair  # noqa: E402
+from narwhal_tpu.consensus.replay import (  # noqa: E402
+    cross_node_prefix,
+    replay_segments,
+)
+from narwhal_tpu.crypto import KeyPair  # noqa: E402
+from narwhal_tpu.faults.spec import FaultScenario, load_scenario  # noqa: E402
+from benchmark.local_bench import (  # noqa: E402
+    build_committee,
+    client_command,
+    kill_stale_nodes,
+    metrics_port,
+    share_rate,
+    wait_for_boot,
+)
+from benchmark.metrics_check import build_timeline  # noqa: E402
+from benchmark.scraper import Scraper  # noqa: E402
+
+# Seconds of boot + store-replay + catch-up the liveness settle-point
+# allows a restarted node (pure-Python ed25519 makes catch-up verify
+# slow on shared-core hosts).
+_RESTART_SETTLE_S = 10.0
+# Settle margin after a partition heals (reconnect backoff, resync).
+_HEAL_SETTLE_S = 3.0
+
+
+def _wan_addresses(committee, name) -> List[str]:
+    """Every address of ``name`` that OTHER authorities dial over the
+    emulated WAN (intra-authority LAN addresses excluded)."""
+    auth = committee.authorities[name]
+    out = [auth.primary.primary_to_primary]
+    for w in auth.workers.values():
+        out.append(w.worker_to_worker)
+    return out
+
+
+def compile_netem(
+    scenario: FaultScenario, committee, keypairs, start_ts: float
+) -> Optional[dict]:
+    """Resolve the scenario's ``wan`` plane into the per-node config file
+    narwhal_tpu/faults/netem.py loads (addresses instead of indices)."""
+    wan = scenario.wan
+    if wan is None:
+        return None
+    names = [kp.name for kp in keypairs]
+    nodes: Dict[str, dict] = {}
+
+    def node_entry(label: str) -> dict:
+        return nodes.setdefault(label, {"rules": [], "partitions": []})
+
+    pair_shapes = {
+        (p.src, p.dst): p for p in wan.pairs
+    }
+    for i in range(scenario.nodes):
+        labels = [f"primary-{i}"] + [
+            f"worker-{i}-{wid}" for wid in range(scenario.workers)
+        ]
+        for j in range(scenario.nodes):
+            if j == i:
+                continue  # intra-authority traffic stays LAN-fast
+            p = pair_shapes.get((i, j))
+            shape = {
+                "latency_ms": p.latency_ms if p else wan.latency_ms,
+                "jitter_ms": p.jitter_ms if p else wan.jitter_ms,
+                "loss": p.loss if p else wan.loss,
+            }
+            if not any(shape.values()):
+                continue
+            for dst in _wan_addresses(committee, names[j]):
+                for label in labels:
+                    node_entry(label)["rules"].append(
+                        dict(shape, dst=dst)
+                    )
+        for part in wan.partitions:
+            group = set(part.group)
+            if i in group:
+                cut = [j for j in range(scenario.nodes) if j not in group]
+            else:
+                cut = [j for j in group]
+            peers = [
+                a
+                for j in cut
+                for a in _wan_addresses(committee, names[j])
+            ]
+            if not peers:
+                continue
+            for label in labels:
+                node_entry(label)["partitions"].append(
+                    {
+                        "peers": peers,
+                        "from_s": part.from_s,
+                        "until_s": part.until_s,
+                    }
+                )
+    return {"seed": scenario.seed, "start_ts": start_ts, "nodes": nodes}
+
+
+def _log_commits_after(
+    log_paths: List[str],
+    settle_ts: float,
+    state: Optional[dict] = None,
+) -> int:
+    """Count payload-digest ``Committed B... -> ...`` log lines at/after
+    the settle point across
+    a primary's per-incarnation logs — the scrape-independent liveness
+    fallback.  A survivor grinding through a post-heal catch-up flood can
+    stall its event loop past the scraper's timeout for every tick
+    (pure-Python batch signature verification), yet its synchronous
+    commit log lines are ground truth that it kept committing.
+
+    ``state`` (path → (byte offset, running count)) makes repeated
+    polling incremental: each call scans only the bytes appended since
+    the last — the grace loop polls every second against logs that grow
+    to tens of MB, and a full rescan per tick is exactly the kind of
+    load the loop exists to ride out.  A partially written last line is
+    left for the next call."""
+    n = 0
+    for path in log_paths:
+        off, cnt = state.get(path, (0, 0)) if state is not None else (0, 0)
+        try:
+            with open(path, "rb") as f:
+                f.seek(off)
+                for raw in f:
+                    if not raw.endswith(b"\n"):
+                        break  # torn tail mid-write: re-scan next call
+                    off += len(raw)
+                    line = raw.decode(errors="replace")
+                    # Only per-payload-digest commit lines ("Committed
+                    # B{round}(...) -> {digest}", emitted because the
+                    # primaries run with --benchmark): a survivor
+                    # committing nothing but EMPTY headers post-settle
+                    # must not read as live — the verdict is about
+                    # client payload, matching the scraped
+                    # payload-batch gate.
+                    if " Committed B" not in line or " -> " not in line:
+                        continue
+                    try:
+                        # node/main.py formats %(asctime)s with logging's
+                        # default LOCALTIME converter (the trailing 'Z' is
+                        # cosmetic) — a naive strptime + .timestamp() reads
+                        # it back in local time.  Parsing it as UTC instead
+                        # shifts every stamp by the host's UTC offset and
+                        # silently inverts the verdict off-UTC hosts.
+                        ts = datetime.datetime.strptime(
+                            line.split(" ", 1)[0], "%Y-%m-%dT%H:%M:%S.%fZ"
+                        ).timestamp()
+                    except ValueError:
+                        continue
+                    if ts >= settle_ts:
+                        cnt += 1
+        except OSError:
+            pass  # unreadable now; the retained count still stands
+        if state is not None:
+            state[path] = (off, cnt)
+        n += cnt
+    return n
+
+
+def _post_settle_delta(samples, node_idx: int, settle_ts: float):
+    """(sample count, committed-batch delta) for one primary over its
+    scraped samples at/after the settle point — the liveness signal."""
+    series = [
+        s["counters"].get("consensus.committed_batch_digests", 0)
+        for s in samples
+        if s["node"] == f"primary-{node_idx}" and s["t"] >= settle_ts
+    ]
+    delta = series[-1] - series[0] if len(series) >= 2 else 0
+    return len(series), delta
+
+
+def run_scenario(
+    scenario: FaultScenario,
+    workdir: str,
+    base_port: int = 9200,
+    quiet: bool = False,
+) -> dict:
+    """Run one arm; returns the artifact fragment for it."""
+    kill_stale_nodes()
+    shutil.rmtree(workdir, ignore_errors=True)
+    os.makedirs(workdir, exist_ok=True)
+    storedir = workdir
+    if os.path.isdir("/dev/shm"):
+        storedir = f"/dev/shm/narwhal_fault_{os.path.basename(workdir)}"
+        shutil.rmtree(storedir, ignore_errors=True)
+        os.makedirs(storedir, exist_ok=True)
+
+    keypairs = [KeyPair.generate() for _ in range(scenario.nodes)]
+    committee = build_committee(keypairs, base_port, scenario.workers)
+    committee.export(f"{workdir}/committee.json")
+    params = Parameters(**scenario.parameters)
+    params.export(f"{workdir}/parameters.json")
+    for i, kp in enumerate(keypairs):
+        export_keypair(kp, f"{workdir}/node-{i}.json")
+
+    # Byzantine plans: one JSON per adversarial node, target indices
+    # resolved to base64 keys (the on-disk committee is re-sorted, so
+    # index order only exists here, where the keypair list is).
+    plan_paths: Dict[int, str] = {}
+    for b in scenario.byzantine:
+        plan = {
+            "behaviors": b.behaviors,
+            "seed": scenario.seed ^ (b.node + 1),
+            "replay_interval_ms": b.replay_interval_ms,
+        }
+        if b.targets:
+            plan["withhold_targets"] = [
+                keypairs[t].name.encode_base64() for t in b.targets
+            ]
+        path = f"{workdir}/byzantine-{b.node}.json"
+        with open(path, "w") as f:
+            json.dump(plan, f, indent=1)
+        plan_paths[b.node] = path
+
+    # The scenario clock: partition windows and crash offsets both anchor
+    # here, just before the committee launches.
+    start_ts = time.time()
+    netem_path = None
+    netem_cfg = compile_netem(scenario, committee, keypairs, start_ts)
+    if netem_cfg is not None:
+        netem_path = f"{workdir}/netem.json"
+        with open(netem_path, "w") as f:
+            json.dump(netem_cfg, f, indent=1)
+
+    base_env = dict(
+        os.environ,
+        PYTHONPATH=REPO,
+        NARWHAL_FAULT_SEED=str(scenario.seed),
+        **scenario.env,
+    )
+
+    procs: List[Tuple[subprocess.Popen, object]] = []
+    procs_by_auth: Dict[int, List[subprocess.Popen]] = {}
+    audit_segments: Dict[int, List[str]] = {}
+    primary_logs: Dict[int, List[str]] = {}
+    incarnation: Dict[int, int] = {}
+    scrape_targets = []
+
+    def spawn(cmd, logfile, env) -> subprocess.Popen:
+        f = open(logfile, "w")
+        p = subprocess.Popen(
+            cmd, stdout=f, stderr=subprocess.STDOUT, env=env, cwd=REPO
+        )
+        procs.append((p, f))
+        return p
+
+    def node_env(label: str, extra: Dict[str, str]) -> dict:
+        env = dict(base_env, NARWHAL_FAULT_NODE=label, **extra)
+        if netem_path:
+            env["NARWHAL_FAULT_NETEM"] = netem_path
+        return env
+
+    def spawn_authority(i: int) -> List[str]:
+        """Launch authority i's primary + workers; returns log paths."""
+        inc = incarnation.get(i, 0)
+        incarnation[i] = inc + 1
+        suffix = "" if inc == 0 else f".r{inc}"
+        logs = []
+        audit = f"{workdir}/audit-primary-{i}.seg{inc}.bin"
+        audit_segments.setdefault(i, []).append(audit)
+        label = f"primary-{i}"
+        log_path = f"{workdir}/primary-{i}{suffix}.log"
+        logs.append(log_path)
+        primary_logs.setdefault(i, []).append(log_path)
+        mport = metrics_port(base_port, scenario.nodes, scenario.workers, i)
+        if inc == 0:
+            scrape_targets.append((label, "127.0.0.1", mport))
+        cmd = [
+            sys.executable, "-m", "narwhal_tpu.node", "run",
+            "--keys", f"{workdir}/node-{i}.json",
+            "--committee", f"{workdir}/committee.json",
+            "--parameters", f"{workdir}/parameters.json",
+            "--store", f"{storedir}/db-primary-{i}",
+            "--benchmark",
+            "--metrics-port", str(mport),
+        ]
+        extra = {"NARWHAL_CONSENSUS_AUDIT": audit}
+        if i in plan_paths:
+            cmd += ["--fault-plan", plan_paths[i]]
+        cmd.append("primary")
+        p = spawn(cmd, log_path, node_env(label, extra))
+        procs_by_auth.setdefault(i, []).append(p)
+        for wid in range(scenario.workers):
+            label = f"worker-{i}-{wid}"
+            log_path = f"{workdir}/worker-{i}-{wid}{suffix}.log"
+            logs.append(log_path)
+            mport = metrics_port(
+                base_port, scenario.nodes, scenario.workers, i, wid
+            )
+            if inc == 0:
+                scrape_targets.append((label, "127.0.0.1", mport))
+            p = spawn(
+                [
+                    sys.executable, "-m", "narwhal_tpu.node", "run",
+                    "--keys", f"{workdir}/node-{i}.json",
+                    "--committee", f"{workdir}/committee.json",
+                    "--parameters", f"{workdir}/parameters.json",
+                    "--store", f"{storedir}/db-worker-{i}-{wid}",
+                    "--metrics-port", str(mport),
+                    "worker", "--id", str(wid),
+                ],
+                log_path,
+                node_env(label, {}),
+            )
+            procs_by_auth.setdefault(i, []).append(p)
+        return logs
+
+    boot_logs: List[str] = []
+    for i in range(scenario.nodes):
+        boot_logs.extend(spawn_authority(i))
+
+    # Committee must be up before the clients open the load window.
+    wait_for_boot(boot_logs, quiet=quiet)
+
+    rate_share = share_rate(scenario.rate, scenario.nodes * scenario.workers)
+    client_idx = 0
+    for i in range(scenario.nodes):
+        for wid in range(scenario.workers):
+            addr = committee.worker(keypairs[i].name, wid).transactions
+            spawn(
+                client_command(addr, scenario.tx_size, rate_share,
+                               client_idx),
+                f"{workdir}/client-{i}-{wid}.log",
+                dict(base_env),
+            )
+            client_idx += 1
+
+    scraper = Scraper(scrape_targets, interval_s=1.0).start()
+
+    # -- the measured window, with the crash/restart timeline ------------------
+    events = sorted(
+        [("crash", c.at_s, c.node) for c in scenario.crash]
+        + [
+            ("restart", c.restart_at_s, c.node)
+            for c in scenario.crash
+            if c.restart_at_s is not None
+        ],
+        key=lambda e: e[1],
+    )
+    end_ts = start_ts + scenario.duration
+    for kind, at_s, node in events:
+        delay = (start_ts + at_s) - time.time()
+        if delay > 0:
+            time.sleep(delay)
+        if kind == "crash":
+            if not quiet:
+                print(f"FAULT: SIGKILL authority {node}", file=sys.stderr)
+            for p in procs_by_auth.get(node, []):
+                try:
+                    p.kill()  # SIGKILL: the torn-tail path is the point
+                except ProcessLookupError:
+                    pass
+            procs_by_auth[node] = []
+        else:
+            if not quiet:
+                print(f"FAULT: restarting authority {node}", file=sys.stderr)
+            spawn_authority(node)
+    remaining = end_ts - time.time()
+    if remaining > 0:
+        time.sleep(remaining)
+
+    live_ok = scraper.wait_for_payload_commits(
+        scenario.progress_wait, quiet=quiet
+    )
+
+    byz = set(scenario.byzantine_nodes())
+    dead_forever = {
+        c.node for c in scenario.crash if c.restart_at_s is None
+    }
+    honest = [i for i in range(scenario.nodes) if i not in byz]
+    survivors = [i for i in honest if i not in dead_forever]
+    settle_s = 0.0
+    for c in scenario.crash:
+        settle_s = max(
+            settle_s,
+            (c.restart_at_s + _RESTART_SETTLE_S)
+            if c.restart_at_s is not None
+            else c.at_s,
+        )
+    if scenario.wan:
+        for part in scenario.wan.partitions:
+            if part.until_s is not None:
+                settle_s = max(settle_s, part.until_s + _HEAL_SETTLE_S)
+    settle_ts = start_ts + settle_s
+
+    # A healed/restarted survivor may still be catching up (slow
+    # pure-Python verify on a shared core; its metrics endpoint starves
+    # too) when the window closes — keep scraping, bounded by
+    # progress_wait, until EVERY survivor shows post-settle commit
+    # progress, so the liveness verdict measures the protocol rather
+    # than this host's scheduling.
+    grace_deadline = time.time() + scenario.progress_wait
+    log_scan_state: dict = {}
+    while time.time() < grace_deadline:
+        lagging = [
+            i for i in survivors
+            if _post_settle_delta(scraper.samples, i, settle_ts)[1] <= 0
+            and _log_commits_after(
+                primary_logs.get(i, []), settle_ts, log_scan_state
+            ) == 0
+        ]
+        if not lagging:
+            break
+        time.sleep(1.0)
+
+    healthz = scraper.healthz_all()
+    scraper.stop()
+
+    # Graceful teardown (SIGTERM flushes final snapshots + audit tails).
+    for p, f in procs:
+        try:
+            p.send_signal(signal.SIGTERM)
+        except ProcessLookupError:
+            pass
+    for p, f in procs:
+        try:
+            p.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            p.wait()
+        f.close()
+
+    if storedir != workdir:
+        # The tmpfs store is per-arm scratch: leaving it would leak a
+        # committee's worth of batch logs into /dev/shm per arm forever
+        # (the audit segments live in workdir, not here).
+        shutil.rmtree(storedir, ignore_errors=True)
+
+    timeline = build_timeline(scraper.samples, interval_s=1.0, healthz=healthz)
+
+    # -- verdicts --------------------------------------------------------------
+    # Safety: golden-oracle replay per honest node + cross-node prefix.
+    safety_nodes = {}
+    sequences = {}
+    for i in honest:
+        verdict = replay_segments(
+            committee, params.gc_depth, audit_segments.get(i, [])
+        )
+        sequences[f"primary-{i}"] = verdict.pop("commit_digests")
+        safety_nodes[f"primary-{i}"] = verdict
+    cross = cross_node_prefix(sequences)
+    safety = {
+        "ok": cross["ok"] and all(v["ok"] for v in safety_nodes.values()),
+        "nodes": safety_nodes,
+        "cross_node": cross,
+    }
+
+    # Liveness: payload commits strictly progress after the fault settles.
+    # Scraped counter deltas are the primary signal; the node's own commit
+    # log lines are the fallback when catch-up load starves its metrics
+    # endpoint (see _log_commits_after).
+    liveness_nodes = {}
+    for i in survivors:
+        samples_n, delta = _post_settle_delta(
+            scraper.samples, i, settle_ts
+        )
+        log_commits = _log_commits_after(
+            primary_logs.get(i, []), settle_ts, log_scan_state
+        )
+        liveness_nodes[f"primary-{i}"] = {
+            "post_settle_samples": samples_n,
+            "committed_batches_delta": delta,
+            "log_commits_post_settle": log_commits,
+            "ok": delta > 0 or log_commits > 0,
+        }
+    liveness = {
+        "ok": bool(liveness_nodes)
+        and all(v["ok"] for v in liveness_nodes.values())
+        and live_ok,
+        "payload_commits_observed": live_ok,
+        "settle_offset_s": settle_s,
+        "nodes": liveness_nodes,
+    }
+
+    # Detection: expected rules FIRING in the committee-wide events track.
+    fired = sorted(
+        {
+            e["rule"]
+            for e in timeline.get("events", [])
+            if e.get("event") == "FIRING"
+        }
+    )
+    missing = [r for r in scenario.expect_rules if r not in fired]
+    detection = {
+        "ok": not missing,
+        "expected": scenario.expect_rules,
+        "fired": fired,
+        "missing": missing,
+    }
+
+    # Fault arms tolerate extra firings (a crash legitimately trips
+    # several rules); the CONTROL arm's zero-firing assertion is what
+    # pins down false positives.
+    if scenario.is_clean():
+        detection["ok"] = not fired
+        detection["expected"] = []
+
+    return {
+        "scenario": dataclasses.asdict(scenario),
+        "seed": scenario.seed,
+        "verdicts": {
+            "safety": safety,
+            "liveness": liveness,
+            "detection": detection,
+        },
+        "ok": safety["ok"] and liveness["ok"] and detection["ok"],
+        "timeline": timeline,
+        "audit_segments": {
+            str(i): segs for i, segs in sorted(audit_segments.items())
+        },
+    }
+
+
+def run(
+    scenario: FaultScenario,
+    workdir_root: str,
+    base_port: int = 9200,
+    control: bool = True,
+    quiet: bool = False,
+) -> dict:
+    """Fault arm + (optionally) clean-control arm; one artifact dict."""
+    if not quiet:
+        print(f"=== scenario {scenario.name} (fault arm)", file=sys.stderr)
+    fault_arm = run_scenario(
+        scenario, os.path.join(workdir_root, scenario.name), base_port, quiet
+    )
+    artifact = {
+        "name": scenario.name,
+        "generated_by": "benchmark/fault_bench.py",
+        "fault_arm": fault_arm,
+        "ok": fault_arm["ok"],
+    }
+    if control and not scenario.is_clean():
+        ctrl = scenario.control_arm()
+        if not quiet:
+            print(f"=== scenario {scenario.name} (control arm)", file=sys.stderr)
+        control_arm = run_scenario(
+            ctrl, os.path.join(workdir_root, ctrl.name), base_port, quiet
+        )
+        artifact["control_arm"] = control_arm
+        artifact["ok"] = artifact["ok"] and control_arm["ok"]
+    return artifact
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--scenario", required=True, action="append",
+                        help="scenario JSON path (repeatable)")
+    parser.add_argument("--artifact", default=None,
+                        help="write the artifact JSON here (one scenario) "
+                        "or use it as a '{name}' template (several)")
+    parser.add_argument("--workdir", default=os.path.join(REPO, ".fault_bench"))
+    parser.add_argument("--base-port", type=int, default=9200)
+    parser.add_argument("--skip-control", action="store_true",
+                        help="skip the clean-control arm (faster; loses the "
+                        "no-false-positive half of the detection verdict)")
+    parser.add_argument("--quiet", action="store_true")
+    args = parser.parse_args()
+
+    if args.artifact and len(args.scenario) > 1 and (
+        "{name}" not in args.artifact
+    ):
+        parser.error(
+            "--artifact must contain '{name}' when several --scenario "
+            "flags are given (a fixed path would silently overwrite "
+            "each scenario's artifact with the next)"
+        )
+
+    failures = 0
+    for path in args.scenario:
+        scenario = load_scenario(path)
+        artifact = run(
+            scenario,
+            args.workdir,
+            base_port=args.base_port,
+            control=not args.skip_control,
+            quiet=args.quiet,
+        )
+        out = args.artifact
+        if out:
+            out = out.replace("{name}", scenario.name)
+            os.makedirs(os.path.dirname(out) or ".", exist_ok=True)
+            with open(out, "w") as f:
+                json.dump(artifact, f, indent=1)
+        verdicts = artifact["fault_arm"]["verdicts"]
+        print(
+            f"{scenario.name}: "
+            + " ".join(
+                f"{k}={'PASS' if v['ok'] else 'FAIL'}"
+                for k, v in verdicts.items()
+            )
+            + (
+                ""
+                if "control_arm" not in artifact
+                else (
+                    " control="
+                    + (
+                        "PASS"
+                        if artifact["control_arm"]["ok"]
+                        else "FAIL"
+                    )
+                )
+            )
+        )
+        if not artifact["ok"]:
+            failures += 1
+            for k, v in verdicts.items():
+                if not v["ok"]:
+                    print(f"  {k} FAILED: {json.dumps(v)[:2000]}",
+                          file=sys.stderr)
+            if "control_arm" in artifact and not artifact["control_arm"]["ok"]:
+                print(
+                    "  control FAILED: "
+                    + json.dumps(
+                        artifact["control_arm"]["verdicts"]
+                    )[:2000],
+                    file=sys.stderr,
+                )
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -68,6 +68,20 @@ def build_committee(keypairs, base_port, workers, ips=None, worker_ips=None):
     return Committee(auths)
 
 
+def metrics_port(base_port, nodes, workers, node, worker=None):
+    """Metrics port for one process, in the block directly above the
+    committee's own ports (``build_committee`` consumes 2+3W consecutive
+    ports per authority starting at ``base_port``).  One definition for
+    every harness: a layout change that only updated one copy would
+    silently collide metrics ports with committee ports in the other.
+    ``worker=None`` addresses authority ``node``'s primary; otherwise
+    its worker ``worker``."""
+    mbase = base_port + nodes * (2 + 3 * workers)
+    if worker is None:
+        return mbase + node
+    return mbase + nodes + node * workers + worker
+
+
 def kill_stale_nodes() -> None:
     """Kill node/client processes left over from a previous run of THIS
     checkout — the reference harness does the same by killing its old tmux
@@ -109,6 +123,58 @@ def kill_stale_nodes() -> None:
                 os.kill(pid, signal.SIGKILL)
             except OSError:
                 pass
+
+
+def wait_for_boot(log_paths, deadline_s: float = 60, quiet: bool = False):
+    """Block until every log in ``log_paths`` contains the node boot
+    sentinel ("successfully booted"), up to ``deadline_s``.  Never start
+    the measured load against a committee that hasn't booted: the e2e
+    window opens at the first client's "Start sending" line, so any boot
+    time the clients outrun is charged to the measurement (the round-3/4
+    failure measured a committee that never came up at all).  Shared with
+    fault_bench so both harnesses watch the same sentinel."""
+    deadline = time.time() + deadline_s
+    pending = set(log_paths)
+    while pending and time.time() < deadline:
+        for p in list(pending):
+            try:
+                if "successfully booted" in open(p).read():
+                    pending.discard(p)
+            except OSError:
+                pass
+        if pending:
+            time.sleep(0.2)
+    if pending and not quiet:
+        print(f"WARNING: nodes never booted: {pending}", file=sys.stderr)
+    return not pending
+
+
+def share_rate(rate: int, n_clients: int) -> int:
+    """Per-client tx rate: the committee-wide rate split evenly, floor 1
+    (reference local.py:78)."""
+    return max(1, rate // max(1, n_clients))
+
+
+def client_command(addr: str, tx_size: int, rate_share: int,
+                   client_idx: int):
+    """argv for one benchmark client against worker ``addr``.  The
+    sample-offset keys each client's latency samples into its own id
+    space so merged logs never collide.  Shared with fault_bench so the
+    fault-arm load is flag-identical to the bench load."""
+    return [
+        sys.executable,
+        "-m",
+        "narwhal_tpu.node.benchmark_client",
+        addr,
+        "--size",
+        str(tx_size),
+        "--rate",
+        str(rate_share),
+        "--sample-offset",
+        str(client_idx << 32),
+        "--nodes",
+        addr,
+    ]
 
 
 def run_bench(
@@ -196,10 +262,9 @@ def run_bench(
     # snapshots would be empty.
     metrics_on = os.environ.get("NARWHAL_METRICS", "1") != "0"
     # Live scrape plane: every node also gets a --metrics-port in the
-    # block directly after the committee's own ports, and the harness
-    # polls them all during the run (benchmark/scraper.py) to build the
-    # committee timeline and gate on /healthz at quiesce.
-    metrics_port_base = base_port + nodes * (2 + 3 * workers)
+    # block directly after the committee's own ports (metrics_port), and
+    # the harness polls them all during the run (benchmark/scraper.py)
+    # to build the committee timeline and gate on /healthz at quiesce.
     scrape_targets = []  # (name, host, port)
 
     def spawn(cmd, logfile, env=cpu_env, tpu=False):
@@ -265,7 +330,7 @@ def run_bench(
         primary_logs.append(log)
         mpath = f"{workdir}/metrics-primary-{i}.json"
         metrics_paths.append(mpath)
-        mport = metrics_port_base + i
+        mport = metrics_port(base_port, nodes, workers, i)
         scrape_targets.append((f"primary-{i}", "127.0.0.1", mport))
         spawn(
             [
@@ -299,7 +364,7 @@ def run_bench(
             worker_logs.append(log)
             mpath = f"{workdir}/metrics-worker-{i}-{wid}.json"
             metrics_paths.append(mpath)
-            mport = metrics_port_base + nodes + i * workers + wid
+            mport = metrics_port(base_port, nodes, workers, i, wid)
             scrape_targets.append((f"worker-{i}-{wid}", "127.0.0.1", mport))
             spawn(
                 [
@@ -327,29 +392,17 @@ def run_bench(
                 log,
             )
 
-    # Never start the measured load against a committee that hasn't booted:
-    # the e2e window opens at the first client's "Start sending" line, so
-    # any boot time the clients outrun is charged to the measurement (the
-    # round-3/4 failure measured a committee that never came up at all).
-    # TPU-backed nodes additionally spend tens of seconds warming XLA
-    # kernels, hence the much longer deadline.
-    deadline = time.time() + (600 if any_tpu else 60)
-    pending = set(primary_logs + worker_logs)
-    while pending and time.time() < deadline:
-        for p in list(pending):
-            try:
-                if "successfully booted" in open(p).read():
-                    pending.discard(p)
-            except OSError:
-                pass
-        if pending:
-            time.sleep(0.2)
-    if pending and not quiet:
-        print(f"WARNING: nodes never booted: {pending}", file=sys.stderr)
+    # TPU-backed nodes spend tens of seconds warming XLA kernels, hence
+    # the much longer boot deadline.
+    wait_for_boot(
+        primary_logs + worker_logs,
+        deadline_s=(600 if any_tpu else 60),
+        quiet=quiet,
+    )
 
     # One client per live worker, rate split evenly (reference local.py:78).
     committee_obj = committee
-    rate_share = max(1, rate // max(1, alive * workers))
+    rate_share = share_rate(rate, alive * workers)
     client_idx = 0
     for i in range(alive):
         kp = keypairs[i]
@@ -357,23 +410,7 @@ def run_bench(
             addr = committee_obj.worker(kp.name, wid).transactions
             log = f"{workdir}/client-{i}-{wid}.log"
             client_logs.append(log)
-            spawn(
-                [
-                    sys.executable,
-                    "-m",
-                    "narwhal_tpu.node.benchmark_client",
-                    addr,
-                    "--size",
-                    str(tx_size),
-                    "--rate",
-                    str(rate_share),
-                    "--sample-offset",
-                    str(client_idx << 32),
-                    "--nodes",
-                    addr,
-                ],
-                log,
-            )
+            spawn(client_command(addr, tx_size, rate_share, client_idx), log)
             client_idx += 1
 
     if not quiet:

@@ -442,6 +442,7 @@ class Consensus:
         fixed_coin: bool = False,
         use_kernel: bool = False,
         checkpoint_path: Optional[str] = None,
+        audit_path: Optional[str] = None,
     ) -> None:
         if use_kernel:
             # Deferred: the pure-CPU node path must not pay the JAX import.
@@ -488,10 +489,13 @@ class Consensus:
         # satisfies dependency checks without replay — so the checkpoint
         # is the backstop for the paths where it does.)
         self.checkpoint_path = checkpoint_path
+        restored_blob = b""
         if checkpoint_path is not None and os.path.exists(checkpoint_path):
             try:
                 with open(checkpoint_path, "rb") as f:
-                    self.tusk.state.restore(f.read())
+                    blob = f.read()
+                self.tusk.state.restore(blob)
+                restored_blob = blob
             except Exception:
                 # A torn/corrupt checkpoint must not crash-loop the node:
                 # the file is a recovery OPTIMIZATION (restore validates
@@ -512,6 +516,18 @@ class Consensus:
                     "Restored consensus frontier at round %d",
                     self.tusk.state.last_committed_round,
                 )
+        # Fault-suite audit segment (consensus/replay.py): every inserted
+        # certificate and every committed digest, for golden-oracle replay
+        # — the safety verdict's raw material.  One segment per process
+        # incarnation; the restore marker anchors the oracle at the same
+        # frontier this instance booted with.
+        self._audit = None
+        if audit_path:
+            from .replay import AuditWriter
+
+            self._audit = AuditWriter(audit_path)
+            self._audit.restore_marker(restored_blob)
+            self._audit.flush()
 
     async def run(self) -> None:
         while True:
@@ -529,6 +545,8 @@ class Consensus:
             committed_any = False
             for certificate in batch:
                 self._m_certs_in.inc()
+                if self._audit is not None:
+                    self._audit.insert(certificate)
                 # cert_inserted: the certificate's payload entered the
                 # commit rule's state — the start of the cert→commit
                 # sub-span attribution.
@@ -556,6 +574,8 @@ class Consensus:
                     self._m_commit_batch.observe(len(sequence))
                     self._m_walk.observe(t_walk - t0)
                 for committed in sequence:
+                    if self._audit is not None:
+                        self._audit.commit(committed)
                     header = committed.header
                     self._m_batches.inc(len(header.payload))
                     for digest in header.payload:
@@ -587,6 +607,11 @@ class Consensus:
                             self._mtrace.mark(
                                 bytes(digest).hex(), "commit", ts=now
                             )
+            if self._audit is not None:
+                # One flush per drained burst: the burst's 'I' and 'C'
+                # records land (or tear) together, which is what lets the
+                # replayer treat a torn tail as a clean prefix.
+                self._audit.flush()
             if committed_any and self.checkpoint_path is not None:
                 # One atomic rewrite per drained burst, AFTER delivery: a
                 # crash in the window re-delivers at most this burst on

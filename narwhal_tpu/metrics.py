@@ -604,6 +604,10 @@ def default_rules(env: Optional[Mapping[str, str]] = None) -> List[HealthRule]:
     retrans_window = f("NARWHAL_HEALTH_PEER_RETRANS_WINDOW_S", 5)
     peer_failures = f("NARWHAL_HEALTH_PEER_FAILURES", 3)
     quorum_wedge_s = f("NARWHAL_HEALTH_QUORUM_WEDGE_S", 10)
+    vote_window = f("NARWHAL_HEALTH_VOTE_SILENCE_WINDOW_S", 8)
+    vote_min_rounds = f("NARWHAL_HEALTH_VOTE_SILENCE_MIN_ROUNDS", 3)
+    stale_rate_max = f("NARWHAL_HEALTH_STALE_RATE", 2)
+    stale_window = f("NARWHAL_HEALTH_STALE_WINDOW_S", 5)
 
     def commit_lag(ctx: HealthContext) -> Dict[str, dict]:
         v = ctx.gauge("consensus.commit_lag_rounds")
@@ -676,6 +680,55 @@ def default_rules(env: Optional[Mapping[str, str]] = None) -> List[HealthRule]:
             detail["quorum_threshold"] = need
         return {"": detail}
 
+    # -- Byzantine-fault detections (fault-injection suite, ISSUE 6).
+    # The first two latch: they read monotone counters of events that a
+    # healthy committee NEVER produces, so once proven the anomaly stays
+    # raised (there is no "un-equivocating").
+
+    def equivocation(ctx: HealthContext) -> Dict[str, dict]:
+        v = ctx.counter("primary.equivocations_detected")
+        if v:
+            return {"": {"equivocations_detected": v}}
+        return {}
+
+    def invalid_signature(ctx: HealthContext) -> Dict[str, dict]:
+        v = ctx.counter("primary.invalid_signatures")
+        if v:
+            return {"": {"invalid_signatures": v}}
+        return {}
+
+    def peer_vote_silence(ctx: HealthContext) -> Dict[str, dict]:
+        # A peer that votes for NONE of our headers while the DAG keeps
+        # advancing is withholding (or wedged) — either way a named
+        # anomaly.  Gated on real round progress over the window so an
+        # idle or booting committee stays silent.
+        rnd_rate = ctx.rate("primary.round", vote_window)
+        if rnd_rate is None or rnd_rate * vote_window < vote_min_rounds:
+            return {}
+        out = {}
+        for peer, rate in ctx.rates_prefixed(
+            "primary.peer_votes.", vote_window
+        ).items():
+            if rate <= 0:
+                out[peer] = {
+                    "rounds_advanced": round(rnd_rate * vote_window, 1),
+                    "window_s": vote_window,
+                }
+        return out
+
+    def stale_replay(ctx: HealthContext) -> Dict[str, dict]:
+        # Past-GC-horizon messages trickling in is normal for a lagging
+        # peer; a sustained RATE of them is a replay flood.
+        rate = ctx.rate("primary.stale_messages", stale_window)
+        if rate is not None and rate > stale_rate_max:
+            return {
+                "": {
+                    "stale_per_s": round(rate, 2),
+                    "threshold": stale_rate_max,
+                }
+            }
+        return {}
+
     def peer_unreachable(ctx: HealthContext) -> Dict[str, dict]:
         out = {}
         for peer, v in ctx.gauges_prefixed(
@@ -715,6 +768,22 @@ def default_rules(env: Optional[Mapping[str, str]] = None) -> List[HealthRule]:
         # threshold debounces), but one extra interval rides out a
         # callback-gauge sample racing the waiter's release.
         HealthRule("quorum_wedge", quorum_wedge, for_intervals=2),
+        # for_intervals=1: an equivocation/rogue signature is PROVEN by a
+        # single event (we hold the signed statements) — no debounce.
+        HealthRule("equivocation", equivocation),
+        HealthRule("invalid_signature", invalid_signature),
+        HealthRule(
+            "peer_vote_silence",
+            peer_vote_silence,
+            for_intervals=2,
+            series=("primary.round", "primary.peer_votes.*"),
+        ),
+        HealthRule(
+            "stale_replay",
+            stale_replay,
+            for_intervals=2,
+            series=("primary.stale_messages",),
+        ),
     ]
 
 

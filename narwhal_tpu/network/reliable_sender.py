@@ -13,11 +13,15 @@ from __future__ import annotations
 
 import asyncio
 import collections
+import functools
 import logging
+import os
+import random
 import weakref
-from typing import Deque, Dict, List, Sequence, Tuple
+from typing import Deque, Dict, List, Optional, Sequence, Tuple
 
 from .. import metrics
+from ..faults import netem as _netem
 from .framing import (
     MAX_FRAME,
     STREAM_LIMIT,
@@ -31,7 +35,53 @@ from .framing import (
 log = logging.getLogger("narwhal.network")
 
 _BACKOFF_START = 0.2
-_BACKOFF_CAP = 60.0
+_BACKOFF_CAP_DEFAULT = 60.0
+
+
+@functools.lru_cache(maxsize=8)
+def _parse_backoff_cap(raw: str) -> float:
+    # Memoized per raw value: backoff_cap() runs once per reconnect
+    # attempt per peer, and a misconfigured value must not warn at retry
+    # frequency forever.
+    try:
+        return max(_BACKOFF_START, float(raw))
+    except ValueError:
+        log.warning(
+            "NARWHAL_NET_BACKOFF_MAX_S=%r is not a number; using %s s",
+            raw, _BACKOFF_CAP_DEFAULT,
+        )
+        return _BACKOFF_CAP_DEFAULT
+
+
+def backoff_cap() -> float:
+    """Reconnect-backoff ceiling in seconds, env-tunable via
+    ``NARWHAL_NET_BACKOFF_MAX_S``.  A 60 s ceiling is right for a dead
+    peer but wrong for a short partition: every sender that backed off to
+    the cap takes up to a minute to notice the heal.  Fault scenarios
+    (and latency-sensitive deployments) lower it."""
+    raw = os.environ.get("NARWHAL_NET_BACKOFF_MAX_S")
+    if raw is None:
+        return _BACKOFF_CAP_DEFAULT
+    return _parse_backoff_cap(raw)
+
+
+def next_backoff(
+    delay: float,
+    cap: Optional[float] = None,
+    rng: random.Random = random,  # type: ignore[assignment]
+) -> Tuple[float, float]:
+    """One step of the reconnect schedule: ``(sleep_s, next_delay)``.
+
+    The sleep is the current delay with 50-100% jitter applied — without
+    it, every peer partitioned at the same instant retries in lockstep
+    and thundering-herds the healed peer's accept queue forever (their
+    backoff clocks stay phase-locked).  The next delay doubles toward the
+    cap; the cap bounds the delay BEFORE jitter, so the worst-case sleep
+    is exactly ``cap``."""
+    cap = backoff_cap() if cap is None else cap
+    delay = min(delay, cap)
+    sleep = delay * (0.5 + 0.5 * rng.random())
+    return sleep, min(delay * 2, cap)
 
 _Item = Tuple[bytes, asyncio.Future]
 # Pending (written, awaiting ACK) items additionally carry the write
@@ -151,10 +201,16 @@ class _Connection:
         try:
             while True:
                 try:
+                    # Fault-injection partition shim: a partitioned peer
+                    # fails exactly like a dead host, through the same
+                    # backoff/health accounting below.
+                    if _netem.blocked(self.address):
+                        raise OSError("netem: partitioned from peer")
                     reader, writer = await asyncio.open_connection(
                         host, port, limit=STREAM_LIMIT
                     )
                     tune_writer(writer)
+                    reader, writer = _netem.wrap(self.address, reader, writer)
                 except OSError as e:
                     log.debug("ReliableSender: cannot reach %s: %s", self.address, e)
                     _m_connect_fail.inc()
@@ -162,8 +218,8 @@ class _Connection:
                     self.failures += 1
                     self._g_failures.set(self.failures)
                     self._g_backoff.set(1)
-                    await asyncio.sleep(delay)
-                    delay = min(delay * 2, _BACKOFF_CAP)
+                    sleep_s, delay = next_backoff(delay)
+                    await asyncio.sleep(sleep_s)
                     continue
                 delay = _BACKOFF_START
                 self.backing_off = False

@@ -12,6 +12,7 @@ import logging
 from typing import Dict, Sequence
 
 from .. import metrics
+from ..faults import netem as _netem
 from .framing import (
     STREAM_LIMIT,
     parse_address,
@@ -41,10 +42,15 @@ class _Peer:
         while True:
             data = await self.queue.get()
             try:
+                # Fault-injection partition shim: best-effort semantics —
+                # a partitioned peer's message is a visible drop.
+                if _netem.blocked(self.address):
+                    raise OSError("netem: partitioned from peer")
                 reader, writer = await asyncio.open_connection(
                     host, port, limit=STREAM_LIMIT
                 )
                 tune_writer(writer)
+                reader, writer = _netem.wrap(self.address, reader, writer)
             except OSError as e:
                 log.debug("SimpleSender: cannot reach %s: %s", self.address, e)
                 _m_dropped.inc()

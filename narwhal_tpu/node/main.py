@@ -159,6 +159,16 @@ def main(argv=None) -> int:
         "verdict).  0 = disabled.",
     )
     run.add_argument(
+        "--fault-plan",
+        default=None,
+        help="FAULT INJECTION: path to a Byzantine plan JSON "
+        "({behaviors, seed, withhold_targets, replay_interval_ms}) that "
+        "swaps this primary's Proposer/Core for their Byzantine wrappers "
+        "(narwhal_tpu/faults/byzantine.py).  The NARWHAL_FAULT_PLAN env "
+        "var is the equivalent knob for harnesses.  Never set this on a "
+        "node you care about: it makes the node ATTACK its committee.",
+    )
+    run.add_argument(
         "--health-interval",
         type=float,
         default=None,
@@ -291,6 +301,18 @@ def main(argv=None) -> int:
             )
 
         if args.role == "primary":
+            fault_plan = None
+            plan_path = args.fault_plan or os.environ.get(
+                "NARWHAL_FAULT_PLAN"
+            )
+            if plan_path:
+                from ..faults.byzantine import ByzantinePlan
+
+                fault_plan = ByzantinePlan.load(plan_path)
+                logging.getLogger("narwhal.node").warning(
+                    "FAULT INJECTION ACTIVE: byzantine behaviors %s",
+                    sorted(fault_plan.behaviors),
+                )
             node = await spawn_primary_node(
                 keypair,
                 committee,
@@ -298,6 +320,7 @@ def main(argv=None) -> int:
                 store_path=f"{args.store}/store.log",
                 benchmark=args.benchmark,
                 use_kernel=args.experimental_consensus_kernel,
+                fault_plan=fault_plan,
             )
         else:
             node = await spawn_worker_node(
@@ -310,6 +333,13 @@ def main(argv=None) -> int:
             )
         try:
             await stop.wait()  # run until SIGTERM/SIGINT
+            # Logged BEFORE teardown: a node whose log simply stops is
+            # indistinguishable from a wedged event loop — this line is
+            # what tells a fault-suite post-mortem "shutdown was asked
+            # for" from "the node went dark".
+            logging.getLogger("narwhal.node").info(
+                "Shutdown signal received; tearing down"
+            )
         finally:
             await node.shutdown()
             if metrics_server is not None:
@@ -322,6 +352,27 @@ def main(argv=None) -> int:
                 # snapshot on disk covers the whole run.
                 snapshot_task.cancel()
                 await asyncio.gather(snapshot_task, return_exceptions=True)
+
+    # NARWHAL_FAULTHANDLER_S=<seconds>: C-level watchdog that dumps every
+    # thread's stack to stderr each interval — it fires even when the
+    # event loop is wedged in CPU-bound Python (where nothing above the
+    # loop can log), which is exactly the state a fault-suite post-mortem
+    # needs to see.  Debug aid; off by default.
+    dump_s = os.environ.get("NARWHAL_FAULTHANDLER_S")
+    if dump_s:
+        try:
+            interval = float(dump_s)
+        except ValueError:
+            logging.getLogger("narwhal.node").warning(
+                "NARWHAL_FAULTHANDLER_S=%r is not a number; watchdog "
+                "disabled",
+                dump_s,
+            )
+            interval = 0.0
+        if interval > 0:
+            import faulthandler
+
+            faulthandler.dump_traceback_later(interval, repeat=True)
 
     # NARWHAL_PROFILE=<dir>: cProfile the whole node, dumping stats on
     # SIGTERM (the harness sends SIGTERM before SIGKILL for this reason).

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 from typing import Callable, List, Optional
 
 from ..config import Committee, Parameters, WorkerId
@@ -68,11 +69,20 @@ async def spawn_primary_node(
     benchmark: bool = False,
     on_commit: Optional[Callable] = None,
     use_kernel: bool = False,
+    fault_plan=None,
+    audit_path: Optional[str] = None,
 ) -> PrimaryNode:
     """Primary + Consensus pair with the GC feedback loop.  `on_commit`
     (sync callable) is the application layer — the reference's `analyze()`
-    stub (main.rs:137-141)."""
+    stub (main.rs:137-141).
+
+    ``fault_plan`` wires the Byzantine Proposer/Core wrappers (fault
+    suite); ``audit_path`` (default: the ``NARWHAL_CONSENSUS_AUDIT`` env
+    var) makes Consensus append its insert/commit audit segment for the
+    golden-oracle safety replay."""
     node = PrimaryNode()
+    if audit_path is None:
+        audit_path = os.environ.get("NARWHAL_CONSENSUS_AUDIT") or None
     loop = asyncio.get_running_loop()
     node.store = Store(store_path)
 
@@ -115,6 +125,7 @@ async def spawn_primary_node(
         checkpoint_path=(
             store_path + ".consensus.ckpt" if store_path else None
         ),
+        audit_path=audit_path,
     )
     if hasattr(consensus.tusk, "prewarm"):
         log.info("Warming up consensus kernel...")
@@ -129,6 +140,7 @@ async def spawn_primary_node(
         tx_consensus=tx_new_certificates,
         rx_consensus=tx_feedback,
         benchmark=benchmark,
+        fault_plan=fault_plan,
     )
     node.tasks.append(loop.create_task(consensus.run()))
 
@@ -139,7 +151,68 @@ async def spawn_primary_node(
                 on_commit(certificate)
 
     node.tasks.append(loop.create_task(analyze()))
+
+    # Far-frontier restore, second half (found by the crash/restart fault
+    # scenario): the checkpoint anchors the committed FRONTIER, but the
+    # DAG between the frontier and the pre-crash head lives only in the
+    # persisted store — and on a store-preserving restart those
+    # certificates never reach consensus again (peers' deliveries pass
+    # their dependency checks against the store, so nothing re-routes the
+    # history), leaving a permanent HOLE in this node's commit sequence
+    # where every healthy peer committed.  Re-seed consensus from the
+    # store: every parseable certificate above the restored per-author
+    # frontier, oldest round first.  Runs as a task after the Primary is
+    # up so the consensus GC feedback loop is already draining.
+    if store_path is not None:
+        node.tasks.append(
+            loop.create_task(
+                _replay_persisted_certificates(
+                    node.store, consensus.tusk.state, tx_new_certificates
+                )
+            )
+        )
     return node
+
+
+async def _replay_persisted_certificates(
+    store: Store, state, tx_consensus: asyncio.Queue
+) -> None:
+    """Feed certificates persisted by a previous incarnation back into
+    the commit rule.  Values that are not certificates (headers fail the
+    decode, payload markers are empty) are skipped; certificates at or
+    below the restored frontier can never commit again (order_dag's ≥
+    skip) and are dropped here instead of costing queue slots."""
+    from ..primary.messages import Certificate
+
+    certs = []
+    for i, value in enumerate(store.values()):
+        if i % 256 == 0 and i:
+            # The scan runs on the freshly booted node's event loop while
+            # peers are already retrying against it — yield so sync
+            # requests, votes and /healthz stay answerable throughout.
+            await asyncio.sleep(0)
+        if len(value) < 140:  # smaller than any vote-carrying certificate
+            continue
+        try:
+            cert = Certificate.deserialize(value)
+        except Exception:
+            continue  # a header or foreign record
+        if not cert.votes:
+            continue
+        if cert.round <= state.last_committed.get(cert.origin, 0):
+            continue
+        certs.append(cert)
+    if not certs:
+        return
+    certs.sort(key=lambda c: c.round)
+    for cert in certs:
+        await tx_consensus.put(cert)
+    log.info(
+        "Replayed %d persisted certificates into consensus "
+        "(restored frontier round %d)",
+        len(certs),
+        state.last_committed_round,
+    )
 
 
 class WorkerNode:

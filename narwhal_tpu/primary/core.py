@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import hashlib
 import os
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
@@ -152,6 +153,26 @@ class Core:
         # process_own_header would mis-aggregate it against the replaced
         # current_header, so it stays inline.
         self._pending_votes: List[Tuple[Round, PublicKey, bytes]] = []
+        # Which header id we voted for per (round, author): the witness
+        # that turns a second, different header for the same slot into a
+        # PROVEN equivocation (the fault-injection detection plane reads
+        # the counter; the `equivocation` health rule fires on it).
+        self.voted_ids: Dict[Round, Dict[PublicKey, Digest]] = {}
+        # Our own header id per round, while the round is within the GC
+        # window: the attribution witness for the per-peer vote counters.
+        # A received vote only counts as "peer X voted for us" if it names
+        # a header we actually proposed — a validly self-signed vote for a
+        # fabricated id must not keep a withholding peer's counter warm.
+        self.own_header_ids: Dict[Round, Digest] = {}
+        # Peers already counted per round: one vote per (round, author)
+        # reaches the counter, so re-sending one old genuine vote over and
+        # over cannot simulate ongoing participation either.
+        self.counted_votes: Dict[Round, Set[PublicKey]] = {}
+        # Conflicting header ids already counted as equivocations, per
+        # round: retransmissions and sync re-sends re-enter
+        # process_header, and each distinct twin must count ONCE — not
+        # once per delivery — or the counter misreports attack magnitude.
+        self.equivocation_ids: Dict[Round, Set[Tuple[PublicKey, Digest]]] = {}
         self._m_headers_in = metrics.counter("primary.headers_processed")
         self._m_votes_in = metrics.counter("primary.votes_received")
         self._m_votes_out = metrics.counter("primary.votes_sent")
@@ -159,18 +180,46 @@ class Core:
         self._m_certs_in = metrics.counter("primary.certificates_processed")
         self._m_dag_errors = metrics.counter("primary.dag_errors")
         self._m_stale = metrics.counter("primary.stale_messages")
+        self._m_late_votes = metrics.counter("primary.late_votes")
+        # FIFO cache of verified header/cert digests (see VERIFIED_CACHE).
+        self._verified_recent: Dict[bytes, None] = {}
+        self._m_verify_cache_hits = metrics.counter(
+            "primary.verify_cache_hits"
+        )
         self._m_vote_flushes = metrics.counter("primary.vote_flushes")
+        # Fault-detection plane (read by the NARWHAL_HEALTH rules):
+        # proven header equivocations, signature-check rejections, and a
+        # per-peer count of votes received from each validator.  The per-peer
+        # counters are registered at boot (value 0) so the vote-silence
+        # rule has a history series for every peer from the first sample.
+        self._m_equivocations = metrics.counter(
+            "primary.equivocations_detected"
+        )
+        self._m_invalid_sigs = metrics.counter("primary.invalid_signatures")
+        self._peer_vote_counters: Dict[PublicKey, metrics.Counter] = {
+            n: metrics.counter(f"primary.peer_votes.{a}")
+            for n, a in self.primary_addresses.items()
+            if n != name
+        }
         self._mtrace = metrics.trace()
         self._rtrace = metrics.round_trace()
 
     # --- processing ---------------------------------------------------------
 
-    async def process_own_header(self, header: Header) -> None:
-        self.current_header = header
-        self.votes_aggregator = VotesAggregator()
-        handlers = self.network.broadcast(
+    def _broadcast_own_header(self, header: Header) -> List:
+        """Ship our freshly minted header to every peer; returns the
+        delivery handlers.  A dedicated seam so the Byzantine wrapper can
+        split-cast or re-sign the wire copy without re-implementing
+        own-header processing."""
+        return self.network.broadcast(
             self.others_addresses, encode_primary_message(header)
         )
+
+    async def process_own_header(self, header: Header) -> None:
+        self.current_header = header
+        self.own_header_ids[header.round] = header.id
+        self.votes_aggregator = VotesAggregator()
+        handlers = self._broadcast_own_header(header)
         self._rtrace.mark(str(header.round), "header_broadcast")
         self.cancel_handlers.setdefault(header.round, []).extend(handlers)
         await self.process_header(header)
@@ -219,19 +268,51 @@ class Core:
         voted = self.last_voted.setdefault(header.round, set())
         if header.author not in voted:
             voted.add(header.author)
+            self.voted_ids.setdefault(header.round, {})[header.author] = (
+                header.id
+            )
             vote = await Vote.new(header, self.name, self.signature_service)
             self._m_votes_out.inc()
             log.debug("Created %r", vote)
-            if vote.origin == self.name:
-                await self.process_vote(vote)
-            elif self.fast_path:
-                self._pending_votes.append(
-                    (header.round, header.author, encode_primary_message(vote))
+            await self._dispatch_vote(vote, header)
+        else:
+            prev_id = self.voted_ids.get(header.round, {}).get(header.author)
+            if prev_id is not None and prev_id != header.id:
+                # Two validly-signed headers from one author for one round:
+                # a PROVEN equivocation (we hold both signed statements).
+                # We already voted for the first — the once-per-slot rule
+                # keeps safety — but the protocol silently tolerating it is
+                # exactly what the fault suite must not: count it so the
+                # `equivocation` health rule names the author.  Each
+                # distinct twin counts once, however many times it is
+                # re-delivered.
+                twin = (header.author, header.id)
+                counted = self.equivocation_ids.setdefault(
+                    header.round, set()
                 )
-            else:
-                address = self.primary_addresses[header.author]
-                handler = self.network.send(address, encode_primary_message(vote))
-                self.cancel_handlers.setdefault(header.round, []).append(handler)
+                if twin not in counted:
+                    counted.add(twin)
+                    self._m_equivocations.inc()
+                    log.warning(
+                        "Equivocation by %r at round %d: voted for %r, "
+                        "now offered %r",
+                        header.author, header.round, prev_id, header.id,
+                    )
+
+    async def _dispatch_vote(self, vote: Vote, header: Header) -> None:
+        """Send (or locally apply) one freshly created vote.  A dedicated
+        seam so the Byzantine wrapper can withhold votes for targeted
+        authors without re-implementing header processing."""
+        if vote.origin == self.name:
+            await self.process_vote(vote)
+        elif self.fast_path:
+            self._pending_votes.append(
+                (header.round, header.author, encode_primary_message(vote))
+            )
+        else:
+            address = self.primary_addresses[header.author]
+            handler = self.network.send(address, encode_primary_message(vote))
+            self.cancel_handlers.setdefault(header.round, []).append(handler)
 
     def _flush_pending(self) -> None:
         """Release the burst's staged votes: ONE coalesced log flush for
@@ -246,6 +327,32 @@ class Core:
         for round, author, body in staged:
             handler = self.network.send(self.primary_addresses[author], body)
             self.cancel_handlers.setdefault(round, []).append(handler)
+
+    def _note_peer_vote(self, vote: Vote) -> None:
+        """Per-peer vote accounting: a validator that stops voting for
+        our headers while rounds keep advancing is withholding — the
+        `peer_vote_silence` rule reads these rates.  Counted at RECEIPT
+        (before the current-header match in sanitize_vote): an
+        honest-but-slow peer whose votes consistently land one round
+        late — after we propose the next header — is still voting, and
+        must not read as silent.  Only signature-backed votes reach
+        here (the burst path verifies votes down to one round late;
+        farther-late votes skip crypto AND counting), and the vote must
+        name the header we actually proposed for its round, at most once
+        per (round, peer) — so neither a forged vote, a validly
+        self-signed vote for a fabricated header id, nor a replayed old
+        genuine vote can keep a withholding peer's counter warm."""
+        if (
+            vote.author != self.name
+            and vote.origin == self.name
+            and self.own_header_ids.get(vote.round) == vote.id
+        ):
+            counted = self.counted_votes.setdefault(vote.round, set())
+            if vote.author not in counted:
+                counted.add(vote.author)
+                peer_votes = self._peer_vote_counters.get(vote.author)
+                if peer_votes is not None:
+                    peer_votes.inc()
 
     async def process_vote(self, vote: Vote) -> None:
         log.debug("Processing %r", vote)
@@ -379,6 +486,8 @@ class Core:
                     self.sanitize_header(item[1], sig_ok)
                     await self.process_header(item[1])
                 elif kind == "vote":
+                    if sig_ok is not False:  # exclude known-forged votes
+                        self._note_peer_vote(item[1])
                     self.sanitize_vote(item[1], sig_ok)
                     await self.process_vote(item[1])
                 elif kind == "certificate":
@@ -393,8 +502,31 @@ class Core:
             elif source == "proposer":
                 await self.process_own_header(item)
         except TooOld as e:
-            self._m_stale.inc()
+            if (
+                source == "primaries"
+                and item[0] == "vote"
+                and item[1].round >= self.gc_round
+            ):
+                # A within-GC-window vote for a header we already
+                # replaced is LATE, not a replay: routine on a busy
+                # committee (the peer's vote raced our next proposal).
+                # Keeping it out of stale_messages is what lets the
+                # stale_replay rule fire on true replay floods without
+                # false-positiving a clean run.  Votes from BELOW the GC
+                # horizon are replay material like headers/certificates
+                # — they stay in stale_messages so a replayed ancient
+                # vote flood still trips the rule.
+                self._m_late_votes.inc()
+            else:
+                self._m_stale.inc()
             log.debug("%s", e)
+        except InvalidSignature as e:
+            # Counted separately from generic DAG errors: a forged or
+            # rogue-key signature never occurs in a healthy committee, so
+            # the `invalid_signature` health rule can fire on count > 0.
+            self._m_invalid_sigs.inc()
+            self._m_dag_errors.inc()
+            log.warning("%s", e)
         except DagError as e:
             self._m_dag_errors.inc()
             log.warning("%s", e)
@@ -411,6 +543,10 @@ class Core:
                 return  # nothing new to collect
             for m in (
                 self.last_voted,
+                self.voted_ids,
+                self.own_header_ids,
+                self.counted_votes,
+                self.equivocation_ids,
                 self.processing,
                 self.certificates_aggregators,
             ):
@@ -425,6 +561,14 @@ class Core:
     # Max messages drained per wakeup: bounds the batch the device verifies
     # and the latency added ahead of the first message's processing.
     DRAIN_LIMIT = 128
+    # Recently-verified header/certificate digests whose re-deliveries
+    # skip crypto.  Catch-up is where this matters: a node resyncing a
+    # gap receives the same certificates several times over (sync-retry
+    # responses race ReliableSender retransmissions), and at pure-Python
+    # verify speeds paying full crypto per duplicate is what let the
+    # re-request flood outrun verification in the partition-heal fault
+    # scenario (100% CPU verifying duplicates, zero commits, 60+ s).
+    VERIFIED_CACHE = 8192
 
     async def _handle_primaries_burst(self, items: List) -> None:
         """Batch-verify the signature claims of a drained burst in one
@@ -443,19 +587,56 @@ class Core:
             # claims here cannot change observable semantics — it only
             # removes a DoS amplification (paying 2f+1 verifications for a
             # certificate the reference rejects pre-crypto).
+            # Votes: only FAR-late votes (2+ rounds behind) skip crypto.
+            # A vote at current_header.round - 1 is the routine race — the
+            # peer voted for the header we just replaced — and it IS
+            # verified, so the receipt-time per-peer counter only ever
+            # counts signature-backed votes (a forged late vote naming a
+            # withholding accomplice cannot keep its counter warm and
+            # suppress peer_vote_silence).  The verify cost is bounded by
+            # the same argument as current-round votes: one signature per
+            # message, no amplification.
             stale = (
                 kind in ("header", "certificate")
                 and item[1].round < self.gc_round
             ) or (
                 kind == "vote"
-                and item[1].round < self.current_header.round
+                and item[1].round + 1 < self.current_header.round
             )
+            # Re-delivery of an already-verified header/certificate skips
+            # crypto via the cache.  The cache key covers the SIGNATURE
+            # bytes, not just the content digest: a re-sent copy whose
+            # signatures were tampered (same header id / cert digest,
+            # corrupted sig) must MISS the cache and pay full verification
+            # — were the key digest-only, the tampered copy would ride
+            # sig_ok=True into process_*, and its store.write would
+            # replace the genuine record with bytes every syncing peer
+            # rejects (a permanent sync hole).  Genuine retransmissions
+            # are byte-identical, so they still hit.
+            dedup_key = None
+            if not stale and kind == "header":
+                h = hashlib.sha256(b"h")
+                h.update(bytes(item[1].id))
+                h.update(bytes(item[1].signature))
+                dedup_key = h.digest()
+            elif not stale and kind == "certificate":
+                h = hashlib.sha256(b"c")
+                h.update(bytes(item[1].digest()))
+                h.update(bytes(item[1].header.signature))
+                for vn, vs in item[1].votes:
+                    h.update(bytes(vn))
+                    h.update(bytes(vs))
+                dedup_key = h.digest()
+            seen = dedup_key is not None and dedup_key in self._verified_recent
+            if seen:
+                self._m_verify_cache_hits.inc()
             claims = (
                 item[1].signature_claims()
-                if not stale and kind in ("header", "vote", "certificate")
+                if not stale and not seen
+                and kind in ("header", "vote", "certificate")
                 else []
             )
-            spans.append((len(msgs), len(claims), stale))
+            spans.append((len(msgs), len(claims), stale, seen, dedup_key))
             for m, k, s in claims:
                 msgs.append(m)
                 keys.append(k)
@@ -465,13 +646,19 @@ class Core:
             if msgs
             else []
         )
-        for item, (off, count, stale) in zip(items, spans):
+        for item, (off, count, stale, seen, dedup_key) in zip(items, spans):
             # Fail CLOSED on stale-filtered items: they carry zero verified
             # claims, so `all([])` would hand them sig_ok=True.  Today the
             # replay raises TooOld on the same round checks before ever
             # consulting sig_ok, but any future drift between this
             # pre-filter and sanitize_* must not skip the signature gate.
-            sig_ok = (not stale) and all(mask[off : off + count])
+            sig_ok = (not stale) and (seen or all(mask[off : off + count]))
+            if dedup_key is not None and sig_ok and not seen:
+                self._verified_recent[dedup_key] = None
+                if len(self._verified_recent) > self.VERIFIED_CACHE:
+                    self._verified_recent.pop(
+                        next(iter(self._verified_recent))
+                    )
             await self._handle("primaries", item, sig_ok)
 
     async def run(self) -> None:

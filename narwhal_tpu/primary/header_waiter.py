@@ -134,11 +134,24 @@ class HeaderWaiter:
         while True:
             await asyncio.sleep(TIMER_RESOLUTION)
             now = time.monotonic()
-            overdue = [
-                d
-                for d, (_, t) in self.parent_requests.items()
-                if now - t >= self.sync_retry_delay
-            ]
+            overdue = []
+            for d, (_, t) in list(self.parent_requests.items()):
+                if now - t < self.sync_retry_delay:
+                    continue
+                if self.store.read(bytes(d)) is not None:
+                    # Satisfied while overdue (the parked header's
+                    # notify_read fired; the batch entry clears only when
+                    # the whole header unparks): a landed certificate must
+                    # fall out of the retry broadcast HERE, because every
+                    # re-request makes sync_retry_nodes peers re-send it,
+                    # and on a catching-up node that duplicate flood
+                    # outruns signature verification — the runaway the
+                    # partition-heal fault scenario exposed (the node
+                    # verified duplicates at 100% CPU for 60+ s and never
+                    # committed again).
+                    del self.parent_requests[d]
+                    continue
+                overdue.append(d)
             if overdue:
                 addresses = [
                     a.primary_to_primary

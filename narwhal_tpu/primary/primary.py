@@ -93,9 +93,15 @@ class Primary:
         tx_consensus: asyncio.Queue,
         rx_consensus: asyncio.Queue,
         benchmark: bool = False,
+        fault_plan=None,
     ) -> "Primary":
         """`tx_consensus` carries fresh certificates to the consensus task;
-        `rx_consensus` brings committed certificates back for GC."""
+        `rx_consensus` brings committed certificates back for GC.
+
+        ``fault_plan`` (a ``narwhal_tpu.faults.byzantine.ByzantinePlan``)
+        swaps the Proposer/Core pair for their Byzantine wrappers — the
+        fault-injection suite's adversary wiring; None (the default) is
+        the honest node."""
         self = cls()
         name = keypair.name
         loop = asyncio.get_running_loop()
@@ -152,7 +158,17 @@ class Primary:
 
         # The Proposer is built first so the Core can hand it parent
         # quorums directly (deliver_parents) instead of through a queue.
-        proposer = Proposer(
+        # A fault plan swaps in the Byzantine wrappers (same wiring, same
+        # channels — the adversary acts only at the network boundary).
+        proposer_cls, core_cls = Proposer, Core
+        extra: tuple = ()
+        if fault_plan is not None and fault_plan.behaviors:
+            from ..faults.byzantine import ByzantineCore, ByzantineProposer
+
+            proposer_cls, core_cls = ByzantineProposer, ByzantineCore
+            extra = (fault_plan,)
+        proposer = proposer_cls(
+            *extra,
             name,
             committee,
             signature_service,
@@ -164,7 +180,8 @@ class Primary:
             benchmark=benchmark,
             min_header_delay_ms=parameters.min_header_delay,
         )
-        core = Core(
+        core = core_cls(
+            *extra,
             name,
             committee,
             store,

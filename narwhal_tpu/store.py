@@ -207,6 +207,11 @@ class Store:
         _m_gets.inc()
         return self._map.get(key)
 
+    def values(self) -> List[bytes]:
+        """Snapshot of every stored value (boot-time recovery scans: the
+        post-restore consensus replay parses these for certificates)."""
+        return list(self._map.values())
+
     async def notify_read(self, key: bytes) -> bytes:
         """Return the value for `key`, parking until it is written if absent
         (reference store/src/lib.rs:47-58)."""

@@ -1,0 +1,142 @@
+"""Live in-process Byzantine committee tests (tier-1 arm of the fault
+suite): a 4-node committee with one adversarial primary must (a) keep
+committing client payload — the paper's under-faults claim — and (b)
+light up the matching detection rule on the honest nodes' registry.
+
+All four nodes share one process/registry (the test_health_failover
+pattern), so the honest Cores' detection counters are directly
+observable and a manually evaluated HealthMonitor pins down the rule
+firing deterministically.  The full multi-process arm (per-node
+registries, WAN shims, crash/restart) runs via benchmark/fault_bench.py;
+artifacts under artifacts/faults_r11/."""
+
+import asyncio
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from narwhal_tpu import metrics  # noqa: E402
+from narwhal_tpu.config import Parameters  # noqa: E402
+from narwhal_tpu.crypto import digest32  # noqa: E402
+from narwhal_tpu.faults.byzantine import ByzantinePlan  # noqa: E402
+from narwhal_tpu.messages import encode_batch  # noqa: E402
+from narwhal_tpu.metrics import HealthMonitor, default_rules  # noqa: E402
+from narwhal_tpu.network.framing import parse_address, write_frame  # noqa: E402
+from narwhal_tpu.node import spawn_primary_node, spawn_worker_node  # noqa: E402
+from tests.common import committee, keys  # noqa: E402
+
+
+def _tx(i: int) -> bytes:
+    return bytes([1]) + (0xFA0000 + i).to_bytes(8, "little") + bytes(91)
+
+
+def _run_byzantine_committee(base_port, behaviors, counter_name, rule_name):
+    """Boot 3 honest + 1 Byzantine node, drive payload through a fault
+    window, and return once (commits survived, detection counter rose,
+    rule fired).  Asserts along the way."""
+    reg = metrics.registry()
+    reg.reset()
+
+    async def go():
+        c = committee(base_port=base_port)
+        params = Parameters(
+            header_size=32,
+            max_header_delay=100,
+            batch_size=400,
+            max_batch_delay=100,
+        )
+        kps = keys()
+        commits = {i: [] for i in range(4)}
+        plan = ByzantinePlan(behaviors, seed=5)
+        nodes = []
+        for i, kp in enumerate(kps):
+            nodes.append(
+                await spawn_primary_node(
+                    kp,
+                    c,
+                    params,
+                    on_commit=lambda cert, i=i: commits[i].append(cert),
+                    fault_plan=plan if i == 3 else None,
+                )
+            )
+            nodes.append(await spawn_worker_node(kp, 0, c, params))
+
+        monitor = HealthMonitor(reg, rules=default_rules({}), interval_s=0.5)
+
+        async def send_txs(ids, node=0):
+            host, port = parse_address(c.worker(kps[node].name, 0).transactions)
+            _, w = await asyncio.open_connection(host, port)
+            txs = [_tx(i) for i in ids]
+            for tx in txs:
+                await write_frame(w, tx)
+            w.close()
+            return {digest32(encode_batch(txs))}
+
+        async def wait_commit(expected, nodes_idx, timeout_s=60):
+            for _ in range(int(timeout_s / 0.1)):
+                if all(
+                    expected
+                    <= {
+                        d
+                        for cert in commits[i]
+                        for d in cert.header.payload
+                    }
+                    for i in nodes_idx
+                ):
+                    return
+                await asyncio.sleep(0.1)
+            raise AssertionError(
+                f"payload never committed on {nodes_idx}: "
+                f"{[len(commits[i]) for i in nodes_idx]}"
+            )
+
+        # Liveness UNDER the fault: the adversary is active from boot,
+        # and honest nodes still commit client payload.
+        batch1 = await send_txs(range(4))
+        await wait_commit(batch1, range(3))
+
+        # Detection: the honest Cores' counter crosses zero...
+        counter = reg.counters.get(counter_name)
+        for _ in range(400):
+            if counter is not None and counter.value > 0:
+                break
+            await asyncio.sleep(0.05)
+            counter = reg.counters.get(counter_name)
+        else:
+            raise AssertionError(f"{counter_name} never incremented")
+
+        # ... and the rule names the anomaly on the next evaluation.
+        firing = {f["rule"] for f in monitor.evaluate()}
+        assert rule_name in firing, f"expected {rule_name}, got {firing}"
+
+        # Still alive after detection: fresh payload keeps committing.
+        batch2 = await send_txs(range(100, 104), node=1)
+        await wait_commit(batch2, range(3))
+
+        for node in nodes:
+            await node.shutdown()
+
+    asyncio.run(asyncio.wait_for(go(), 120))
+
+
+def test_equivocating_primary_detected_and_committee_survives():
+    """Split-brain headers: the twin-voting honest node proves the
+    equivocation when the real header's certificate reaches it."""
+    _run_byzantine_committee(
+        base_port=15900,
+        behaviors=["equivocate"],
+        counter_name="primary.equivocations_detected",
+        rule_name="equivocation",
+    )
+
+
+def test_wrong_key_primary_detected_and_committee_survives():
+    """Rogue-key signatures: every honest node rejects the headers at the
+    signature gate and the invalid_signature rule latches."""
+    _run_byzantine_committee(
+        base_port=15930,
+        behaviors=["wrong_key"],
+        counter_name="primary.invalid_signatures",
+        rule_name="invalid_signature",
+    )

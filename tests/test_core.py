@@ -617,3 +617,264 @@ def test_core_requires_a_parent_quorum_sink():
             rx_certificate_waiter=qs[4], rx_proposer=qs[5],
             tx_consensus=asyncio.Queue(),
         )
+
+
+def test_duplicate_delivery_skips_crypto_via_verified_cache(run):
+    """Re-delivery of an already-verified header pays ZERO crypto (the
+    verified-digest cache): during catch-up the same certificates arrive
+    several times over (sync-retry responses race retransmissions), and
+    at pure-Python verify speeds paying per-copy crypto is what let the
+    re-request flood outrun verification in the partition-heal fault
+    scenario.  A rejected forgery must NOT enter the cache."""
+
+    async def go():
+        from narwhal_tpu.crypto import backend as cb
+        from narwhal_tpu.crypto import Signature
+
+        c = committee()
+        me, author = keys()[0], keys()[1]
+        core, store, qs = make_core(c, me)
+        header = make_header(author, c=c)
+        some_parents = sorted(x.digest() for x in genesis(c))[:3]
+        forged = make_header(keys()[2], parents=some_parents, c=c)
+        forged.signature = Signature(bytes(64))
+
+        seen = []
+
+        async def recording(source, item, sig_ok):
+            seen.append((item[1].id, sig_ok))
+
+        core._handle = recording
+        calls = []
+        real = cb.averify_batch_mask
+
+        async def counting(msgs, ks, ss):
+            calls.append(len(msgs))
+            return await real(msgs, ks, ss)
+
+        cb.averify_batch_mask = counting
+        try:
+            await core._handle_primaries_burst([("header", header)])
+            # Re-delivery: replayed with sig_ok=True, zero backend calls.
+            await core._handle_primaries_burst([("header", header)])
+            assert calls == [1], calls
+            assert seen == [(header.id, True), (header.id, True)]
+            # A forgery is rejected AND stays out of the cache: its
+            # re-delivery is re-verified (and re-rejected), not waved in.
+            await core._handle_primaries_burst([("header", forged)])
+            await core._handle_primaries_burst([("header", forged)])
+            assert calls == [1, 1, 1], calls
+            assert seen[-2:] == [(forged.id, False), (forged.id, False)]
+        finally:
+            cb.averify_batch_mask = real
+        core.network.close()
+
+    run(go())
+
+
+def test_tampered_redelivery_misses_cache_and_is_rejected(run):
+    """The verified cache keys on the SIGNATURE bytes, not just the
+    content digest: a re-sent header/certificate whose signatures were
+    tampered (same id / digest) must pay crypto again and be rejected —
+    a digest-only key would wave it through with sig_ok=True and its
+    store.write would replace the genuine record with bytes every
+    syncing peer rejects."""
+
+    async def go():
+        from narwhal_tpu.crypto import Signature
+        from narwhal_tpu.crypto import backend as cb
+        from narwhal_tpu.primary.messages import Certificate, Header
+
+        c = committee()
+        me, author = keys()[0], keys()[1]
+        core, store, qs = make_core(c, me)
+
+        seen = []
+
+        async def recording(source, item, sig_ok):
+            seen.append(sig_ok)
+
+        core._handle = recording
+        calls = []
+        real = cb.averify_batch_mask
+
+        async def counting(msgs, ks, ss):
+            calls.append(len(msgs))
+            return await real(msgs, ks, ss)
+
+        cb.averify_batch_mask = counting
+        try:
+            # Header: same id, corrupted signature.
+            header = make_header(author, c=c)
+            tampered = Header(
+                author=header.author, round=header.round,
+                payload=dict(header.payload), parents=set(header.parents),
+            )
+            tampered.id = header.id
+            tampered.signature = Signature(bytes(64))
+            await core._handle_primaries_burst([("header", header)])
+            await core._handle_primaries_burst([("header", tampered)])
+            assert len(calls) == 2, calls  # tampered copy re-verified...
+            assert seen == [True, False]  # ...and rejected
+            # The genuine copy still rides the cache afterwards.
+            await core._handle_primaries_burst([("header", header)])
+            assert len(calls) == 2 and seen[-1] is True
+
+            # Certificate: same digest, one vote signature corrupted.
+            cert = make_certificate(make_header(keys()[2], c=c))
+            votes = list(cert.votes)
+            votes[0] = (votes[0][0], Signature(bytes(64)))
+            tampered_cert = Certificate(header=cert.header, votes=votes)
+            assert tampered_cert.digest() == cert.digest()
+            await core._handle_primaries_burst([("certificate", cert)])
+            await core._handle_primaries_burst(
+                [("certificate", tampered_cert)]
+            )
+            assert len(calls) == 4, calls
+            assert seen[-2:] == [True, False]
+            await core._handle_primaries_burst([("certificate", cert)])
+            assert len(calls) == 4 and seen[-1] is True
+        finally:
+            cb.averify_batch_mask = real
+        core.network.close()
+
+    run(go())
+
+
+def test_late_vote_still_counts_toward_peer_votes(run):
+    """A vote that races our next proposal (one round late) is verified
+    and still reaches the receipt-time per-peer counter: an
+    honest-but-slow peer is voting, and must not read as silent to
+    peer_vote_silence.  Everything that is NOT a genuine, fresh vote for
+    a header we actually proposed is excluded: far-late votes (2+
+    rounds) skip crypto AND counting, a forged near-late vote is
+    verified and excluded, a validly SELF-signed vote naming a header id
+    we never proposed is excluded, and a re-delivered copy of a genuine
+    vote counts once — a Byzantine node cannot keep a withholding
+    accomplice's (or its own) counter warm with any of them."""
+
+    async def go():
+        from narwhal_tpu.crypto import Signature
+        from narwhal_tpu.crypto import backend as cb
+
+        c = committee()
+        me = keys()[0]
+        core, store, qs = make_core(c, me)
+        h1 = make_header(me, c=c)
+        core.current_header = make_header(me, round_=2, c=c)
+        # The attribution witness process_own_header would have written.
+        core.own_header_ids[1] = h1.id
+        core.own_header_ids[2] = core.current_header.id
+
+        vote = make_votes(h1)[0]  # round 1 == 2-1: late
+        counter = core._peer_vote_counters[vote.author]
+        before_peer = counter.value
+        before_late = core._m_late_votes.value
+        before_stale = core._m_stale.value
+
+        calls = []
+        real = cb.averify_batch_mask
+
+        async def counting(msgs, ks, ss):
+            calls.append(len(msgs))
+            return await real(msgs, ks, ss)
+
+        cb.averify_batch_mask = counting
+        try:
+            # Near-late vote in a MIXED burst with a fresh header: the
+            # vote's claim is verified alongside the header's in the one
+            # batch call, and the vote is counted.
+            fresh = make_header(keys()[2], c=c)
+            await core._handle_primaries_burst(
+                [("vote", vote), ("header", fresh)]
+            )
+            assert calls == [2], calls  # vote + header claims, one batch
+            assert counter.value == before_peer + 1  # peer is NOT silent
+            assert core._m_late_votes.value == before_late + 1
+            assert core._m_stale.value == before_stale  # late ≠ replay
+
+            # Re-delivered copy of the SAME genuine vote (retransmission,
+            # or deliberate replay by the voter): once per (round, peer).
+            await core._handle_primaries_burst([("vote", vote)])
+            assert calls == [2, 1], calls
+            assert counter.value == before_peer + 1
+            assert core._m_late_votes.value == before_late + 2
+
+            # Validly self-signed vote naming a header id we NEVER
+            # proposed for its round: signature passes, attribution
+            # fails, NOT counted (it is not a vote for us).
+            phantom = make_header(
+                me, round_=2, payload={digest32(b"phantom"): 0}, c=c
+            )
+            assert phantom.id != core.own_header_ids[2]
+            fabricated = make_votes(phantom)[0]
+            await core._handle_primaries_burst([("vote", fabricated)])
+            assert calls == [2, 1, 1], calls
+            assert counter.value == before_peer + 1
+
+            # Far-late (2+ rounds behind): zero crypto, NOT counted, and
+            # still within the GC window so it reads as LATE, not stale.
+            core.current_header = make_header(me, round_=3, c=c)
+            core.own_header_ids[3] = core.current_header.id
+            await core._handle_primaries_burst([("vote", vote)])
+            assert calls == [2, 1, 1], calls
+            assert counter.value == before_peer + 1
+            assert core._m_late_votes.value == before_late + 3
+
+            # Forged near-late vote: verified, rejected, NOT counted
+            # (the round check still classifies it late before the
+            # signature gate ever matters).
+            forged = make_votes(make_header(me, round_=2, c=c))[0]
+            forged.signature = Signature(bytes(64))
+            await core._handle_primaries_burst([("vote", forged)])
+            assert calls == [2, 1, 1, 1], calls
+            assert counter.value == before_peer + 1
+            assert core._m_late_votes.value == before_late + 4
+
+            # Below the GC horizon: a replayed ancient vote is REPLAY
+            # material like a header/certificate — it lands in
+            # stale_messages (feeding the stale_replay rule), not in
+            # late_votes, and still skips crypto and counting.
+            core.gc_round = 5
+            core.current_header = make_header(me, round_=6, c=c)
+            await core._handle_primaries_burst([("vote", vote)])
+            assert calls == [2, 1, 1, 1], calls
+            assert counter.value == before_peer + 1
+            assert core._m_late_votes.value == before_late + 4  # unchanged
+            assert core._m_stale.value == before_stale + 1
+        finally:
+            cb.averify_batch_mask = real
+        core.network.close()
+
+    run(go())
+
+
+def test_equivocation_counted_once_per_twin(run):
+    """Retransmissions and sync re-sends re-deliver the same conflicting
+    header; each distinct twin must count ONCE toward
+    primary.equivocations_detected, or the counter misreports attack
+    magnitude.  A third distinct header for the slot is a new proven
+    statement and counts again."""
+
+    async def go():
+        c = committee()
+        me, author = keys()[0], keys()[1]
+        core, store, qs = make_core(c, me)
+        g = sorted(x.digest() for x in genesis(c))
+        h1 = make_header(author, parents=set(g), c=c)
+        twin = make_header(author, parents=set(g[:3]), c=c)
+        third = make_header(author, parents=set(g[1:]), c=c)
+        assert len({h1.id, twin.id, third.id}) == 3
+        base = core._m_equivocations.value
+
+        await core.process_header(h1)  # we vote for h1
+        await core.process_header(twin)
+        assert core._m_equivocations.value == base + 1
+        await core.process_header(twin)  # re-delivery: no double count
+        await core.process_header(twin)
+        assert core._m_equivocations.value == base + 1
+        await core.process_header(third)
+        assert core._m_equivocations.value == base + 2
+        core.network.close()
+
+    run(go())

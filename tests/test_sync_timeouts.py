@@ -1,0 +1,337 @@
+"""Timeout-path coverage for the dependency-sync machinery (ISSUE 6
+satellite): the HeaderWaiter's parent-request escalation after the sync
+deadline, cancellation of the retry once the obligation is satisfied, the
+worker-fetch command for missing batches, and the CertificateWaiter's
+park/release/GC discipline.  These are the paths a crash/restart scenario
+leans on (a restarted node is one big missing-dependency storm), so they
+need direct, deterministic tests — not just incidental e2e coverage."""
+
+import asyncio
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from narwhal_tpu.crypto import Digest  # noqa: E402
+from narwhal_tpu.network import Receiver  # noqa: E402
+from narwhal_tpu.primary import header_waiter as hw_mod  # noqa: E402
+from narwhal_tpu.primary.core import AtomicRound  # noqa: E402
+from narwhal_tpu.primary.certificate_waiter import CertificateWaiter  # noqa: E402
+from narwhal_tpu.primary.header_waiter import HeaderWaiter  # noqa: E402
+from narwhal_tpu.primary.messages import decode_primary_message  # noqa: E402
+from narwhal_tpu.primary.synchronizer import payload_key  # noqa: E402
+from narwhal_tpu.store import Store  # noqa: E402
+from tests.common import (  # noqa: E402
+    RecordingAckHandler,
+    committee,
+    keys,
+    make_certificate,
+    make_header,
+)
+
+
+def _requests_for(handler, digest):
+    """certificates_request frames at this receiver naming `digest`."""
+    hits = 0
+    for frame in handler.received:
+        try:
+            decoded = decode_primary_message(frame)
+        except ValueError:
+            continue
+        if decoded[0] == "certificates_request" and digest in decoded[1]:
+            hits += 1
+    return hits
+
+
+def test_missing_parent_rerequested_after_deadline_then_cancelled(
+    monkeypatch,
+):
+    """A missing parent is requested from the author immediately; once the
+    sync deadline passes the timer escalates to `sync_retry_nodes` random
+    peers; writing the parent releases the parked header AND cancels the
+    retry loop (no further requests)."""
+    monkeypatch.setattr(hw_mod, "TIMER_RESOLUTION", 0.05)
+
+    async def go():
+        c = committee(base_port=15800)
+        kps = keys()
+        name = kps[0].name
+        handlers = {}
+        receivers = []
+        for kp in kps[1:]:
+            h = RecordingAckHandler()
+            addr = c.primary(kp.name).primary_to_primary
+            receivers.append(await Receiver.spawn(addr, h))
+            handlers[kp.name] = h
+
+        store = Store()
+        rx = asyncio.Queue()
+        tx_core = asyncio.Queue()
+        waiter = HeaderWaiter(
+            name,
+            c,
+            store,
+            AtomicRound(),
+            gc_depth=50,
+            sync_retry_delay_ms=150,
+            sync_retry_nodes=3,
+            rx_synchronizer=rx,
+            tx_core=tx_core,
+        )
+        task = asyncio.get_running_loop().create_task(waiter.run())
+
+        missing = Digest(bytes([7]) * 32)
+        header = make_header(kps[1], round_=2, parents={missing}, c=c)
+        await rx.put(("sync_parents", [missing], header))
+
+        # Initial optimistic request goes to the header author.
+        author_h = handlers[kps[1].name]
+        await asyncio.wait_for(author_h.arrived.wait(), 5)
+        assert _requests_for(author_h, missing) >= 1
+
+        def total():
+            return sum(_requests_for(h, missing) for h in handlers.values())
+
+        # Past the deadline the timer escalates via lucky_broadcast: the
+        # committee-wide request count must GROW beyond the initial ask.
+        initial = total()
+        deadline = asyncio.get_running_loop().time() + 5
+        while total() <= initial:
+            assert asyncio.get_running_loop().time() < deadline, (
+                "sync deadline passed but no re-request escalated"
+            )
+            await asyncio.sleep(0.05)
+
+        # Satisfy the obligation: the parked header loops back to the
+        # Core and the request bookkeeping empties.
+        store.write(bytes(missing), b"anything")
+        out = await asyncio.wait_for(tx_core.get(), 5)
+        assert out.id == header.id
+        assert waiter.pending == {}
+        assert waiter.parent_requests == {}
+
+        # ... and the retry is actually CANCELLED: several more timer
+        # periods produce zero new requests.
+        settled = total()
+        await asyncio.sleep(0.5)
+        assert total() == settled, "retry kept firing after satisfaction"
+
+        task.cancel()
+        await asyncio.gather(task, return_exceptions=True)
+        waiter.sender.close()
+        for r in receivers:
+            await r.shutdown()
+
+    asyncio.run(asyncio.wait_for(go(), 30))
+
+
+def test_missing_batch_commands_worker_fetch_then_releases(monkeypatch):
+    """A missing payload batch sends a Synchronize command to OUR worker
+    serving that id, parks the header on the (digest ‖ worker_id) store
+    key, and releases it the moment the worker stores the batch marker."""
+    monkeypatch.setattr(hw_mod, "TIMER_RESOLUTION", 0.05)
+
+    async def go():
+        c = committee(base_port=15830)
+        kps = keys()
+        name = kps[0].name
+        worker_h = RecordingAckHandler()
+        worker_addr = c.worker(name, 0).primary_to_worker
+        receiver = await Receiver.spawn(worker_addr, worker_h)
+
+        store = Store()
+        rx = asyncio.Queue()
+        tx_core = asyncio.Queue()
+        waiter = HeaderWaiter(
+            name,
+            c,
+            store,
+            AtomicRound(),
+            gc_depth=50,
+            sync_retry_delay_ms=150,
+            sync_retry_nodes=3,
+            rx_synchronizer=rx,
+            tx_core=tx_core,
+        )
+        task = asyncio.get_running_loop().create_task(waiter.run())
+
+        digest = Digest(bytes([9]) * 32)
+        header = make_header(kps[1], round_=2, c=c)
+        await rx.put(("sync_batches", {digest: 0}, header))
+
+        # The fetch command reaches our worker and names the digest.
+        await asyncio.wait_for(worker_h.arrived.wait(), 5)
+        assert any(bytes(digest) in f for f in worker_h.received)
+        assert header.id in waiter.pending
+
+        # The worker "fetches" the batch: writing the payload marker
+        # releases the parked header.
+        store.write(payload_key(digest, 0), b"")
+        out = await asyncio.wait_for(tx_core.get(), 5)
+        assert out.id == header.id
+        assert waiter.pending == {}
+
+        task.cancel()
+        await asyncio.gather(task, return_exceptions=True)
+        waiter.sender.close()
+        await receiver.shutdown()
+
+    asyncio.run(asyncio.wait_for(go(), 30))
+
+
+def test_certificate_waiter_parks_until_all_parents_then_releases():
+    async def go():
+        c = committee()
+        kps = keys()
+        store = Store()
+        rx = asyncio.Queue()
+        tx_core = asyncio.Queue()
+        waiter = CertificateWaiter(
+            store, AtomicRound(), gc_depth=10, rx_synchronizer=rx,
+            tx_core=tx_core,
+        )
+        task = asyncio.get_running_loop().create_task(waiter.run())
+
+        p1, p2 = Digest(bytes([1]) * 32), Digest(bytes([2]) * 32)
+        cert = make_certificate(
+            make_header(kps[1], round_=3, parents={p1, p2}, c=c)
+        )
+        await rx.put(cert)
+        await asyncio.sleep(0.1)
+        assert cert.digest() in waiter.pending
+        assert tx_core.empty()
+
+        # One parent is not enough; the SECOND write releases the loop-back.
+        store.write(bytes(p1), b"x")
+        await asyncio.sleep(0.1)
+        assert tx_core.empty()
+        store.write(bytes(p2), b"y")
+        out = await asyncio.wait_for(tx_core.get(), 5)
+        assert out.digest() == cert.digest()
+        assert waiter.pending == {}
+
+        task.cancel()
+        await asyncio.gather(task, return_exceptions=True)
+
+    asyncio.run(asyncio.wait_for(go(), 30))
+
+
+def test_certificate_waiter_gc_cancels_stale_obligations():
+    """A parked certificate whose round falls behind the GC horizon is
+    dropped and its notify_read task cancelled — the obligation must not
+    outlive the round it serves (a restarted committee floods this path)."""
+
+    async def go():
+        c = committee()
+        kps = keys()
+        store = Store()
+        rx = asyncio.Queue()
+        tx_core = asyncio.Queue()
+        consensus_round = AtomicRound()
+        waiter = CertificateWaiter(
+            store, consensus_round, gc_depth=10, rx_synchronizer=rx,
+            tx_core=tx_core,
+        )
+        task = asyncio.get_running_loop().create_task(waiter.run())
+
+        old_parent = Digest(bytes([3]) * 32)
+        stale = make_certificate(
+            make_header(kps[1], round_=3, parents={old_parent}, c=c)
+        )
+        await rx.put(stale)
+        await asyncio.sleep(0.1)
+        assert stale.digest() in waiter.pending
+        parked_task = waiter.pending[stale.digest()][1]
+
+        # Consensus moves on: round 20 puts the gc horizon at 10 > 3.
+        consensus_round.value = 20
+        fresh_parent = Digest(bytes([4]) * 32)
+        fresh = make_certificate(
+            make_header(kps[2], round_=19, parents={fresh_parent}, c=c)
+        )
+        await rx.put(fresh)  # any message triggers the GC sweep
+        await asyncio.sleep(0.1)
+        assert stale.digest() not in waiter.pending
+        assert fresh.digest() in waiter.pending
+        assert parked_task.cancelled() or parked_task.done()
+        # The store obligation is gone too (cancelled waiters un-park).
+        assert bytes(old_parent) not in store._obligations
+
+        task.cancel()
+        await asyncio.gather(task, return_exceptions=True)
+
+    asyncio.run(asyncio.wait_for(go(), 30))
+
+
+def test_landed_parent_drops_out_of_retry_while_sibling_still_missing(
+    monkeypatch,
+):
+    """A header parked on TWO missing parents: once one of them lands in
+    the store, the timer must stop re-requesting it (helpful peers would
+    re-send it every period — the duplicate flood that outran signature
+    verification in the partition-heal fault scenario) while the still-
+    missing sibling keeps escalating."""
+    monkeypatch.setattr(hw_mod, "TIMER_RESOLUTION", 0.05)
+
+    async def go():
+        c = committee(base_port=15860)
+        kps = keys()
+        name = kps[0].name
+        handlers = {}
+        receivers = []
+        for kp in kps[1:]:
+            h = RecordingAckHandler()
+            addr = c.primary(kp.name).primary_to_primary
+            receivers.append(await Receiver.spawn(addr, h))
+            handlers[kp.name] = h
+
+        store = Store()
+        rx = asyncio.Queue()
+        tx_core = asyncio.Queue()
+        waiter = HeaderWaiter(
+            name,
+            c,
+            store,
+            AtomicRound(),
+            gc_depth=50,
+            sync_retry_delay_ms=100,
+            sync_retry_nodes=3,
+            rx_synchronizer=rx,
+            tx_core=tx_core,
+        )
+        task = asyncio.get_running_loop().create_task(waiter.run())
+
+        landed = Digest(bytes([5]) * 32)
+        missing = Digest(bytes([6]) * 32)
+        header = make_header(kps[1], round_=2, parents={landed, missing}, c=c)
+        await rx.put(("sync_parents", [landed, missing], header))
+        await asyncio.wait_for(handlers[kps[1].name].arrived.wait(), 5)
+
+        def total(digest):
+            return sum(_requests_for(h, digest) for h in handlers.values())
+
+        # One parent lands; the header stays parked on the other.
+        store.write(bytes(landed), b"cert-bytes")
+
+        # The sibling keeps escalating...
+        base_missing = total(missing)
+        deadline = asyncio.get_running_loop().time() + 5
+        while total(missing) <= base_missing:
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.05)
+        assert header.id in waiter.pending  # still parked
+
+        # ... but the landed one fell out of the retry set and its
+        # request count stops growing over several more periods.
+        assert landed not in waiter.parent_requests
+        settled = total(landed)
+        await asyncio.sleep(0.4)
+        assert total(landed) == settled, "landed parent kept being re-requested"
+
+        task.cancel()
+        await asyncio.gather(task, return_exceptions=True)
+        waiter.sender.close()
+        for r in receivers:
+            await r.shutdown()
+
+    asyncio.run(asyncio.wait_for(go(), 30))
