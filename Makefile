@@ -10,7 +10,8 @@
 
 PYTHON ?= python
 
-.PHONY: check native lint test test-ci metrics-smoke fault-smoke bench clean
+.PHONY: check native lint test test-ci metrics-smoke fault-smoke \
+	trajectory bench clean
 
 check: native lint test
 
@@ -58,6 +59,16 @@ fault-smoke:
 		--scenario benchmark/scenarios/byz_wrong_key.json \
 		--scenario benchmark/scenarios/crash_restart.json \
 		--artifact '.ci-artifacts/fault-{name}.json'
+
+# Cross-revision perf-trajectory gate (benchmark/trajectory.py): reads
+# every BENCH_r*.json + recognizable artifacts/ bench capture, renders
+# the revision series, and exits nonzero on any regression beyond the
+# tolerances pinned in benchmark/trajectory_gate.json that no waiver
+# names.  The rendered report lands in .ci-artifacts/ for upload.
+trajectory:
+	mkdir -p .ci-artifacts
+	$(PYTHON) benchmark/trajectory.py \
+		--report .ci-artifacts/trajectory.json
 
 # The crypto differential suite under the float32 lane dtype (the default
 # run covers int32 + a narrow f32 subprocess check; run this after any

@@ -202,6 +202,20 @@ def main() -> None:
                 "stages_ms": result.stages_ms,
                 "metrics_committed_tx": round(result.metrics_committed_tx, 1),
                 "metrics_disagreement": result.metrics_disagreement,
+                # Wire-goodput & crypto-cost headline (median run): the
+                # cross-revision numbers benchmark/trajectory.py tracks.
+                "goodput_ratio": result.wire.get("goodput_ratio"),
+                "cert_sig_bytes_fraction": result.wire.get(
+                    "cert_sig_bytes_fraction"
+                ),
+                "empty_cert_overhead_per_committed_byte": result.wire.get(
+                    "empty_cert_overhead_per_committed_byte"
+                ),
+                "wire_totals": result.wire.get("totals", {}),
+                "crypto_verify": {
+                    site: d.get("ops")
+                    for site, d in result.crypto.get("verify", {}).items()
+                },
                 **({"errors": errors[:10]} if errors else {}),
                 **crypto,
             }

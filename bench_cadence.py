@@ -126,7 +126,7 @@ async def run_arm(committee, kps, me_kp, prebuilt, fast_path: bool, store_path: 
 
     real = crypto_backend.averify_batch_mask
 
-    async def stub(msgs, keys, sigs):
+    async def stub(msgs, keys, sigs, site="other"):
         return [True] * len(msgs)
 
     crypto_backend.averify_batch_mask = stub

@@ -37,6 +37,7 @@ from benchmark.metrics_check import (  # noqa: E402
     check_quiesce_health,
     cross_validate,
     load_snapshots,
+    wire_crypto_summary,
 )
 from benchmark.scraper import Scraper  # noqa: E402
 
@@ -478,6 +479,14 @@ def run_bench(
     if metrics_on:
         snapshots = load_snapshots(metrics_paths, result.errors)
         cross_validate(result, snapshots, tx_size)
+        # Wire-goodput + crypto-cost ledger sections (the `wire` and
+        # `crypto` keys of the bench JSON).
+        wc = wire_crypto_summary(
+            snapshots,
+            committed_payload_bytes=result.committed_bytes,
+            quorum_weight=committee.quorum_threshold(),
+        )
+        result.wire, result.crypto = wc["wire"], wc["crypto"]
         check_quiesce_health(healthz, result.errors)
         result.timeline = build_timeline(
             scraper.samples if scraper else [],
@@ -574,6 +583,11 @@ def main():
                         result.metrics_committed_tx, 1
                     ),
                     "metrics_disagreement": result.metrics_disagreement,
+                    # Wire-goodput & crypto-cost ledgers: per-type
+                    # bandwidth (retransmits split out), goodput ratio,
+                    # per-site sign/verify attribution + protocol check.
+                    "wire": result.wire,
+                    "crypto": result.crypto,
                     # Live committee timeline (scraper): per-node series,
                     # per-peer RTT matrix, /healthz verdicts at quiesce.
                     "timeline": result.timeline,
@@ -590,6 +604,47 @@ def main():
             print(" + ROUND CADENCE (mean ms per sub-leg):")
             for name, ms in result.round_stages_ms.items():
                 print(f"   {name}: {ms:,.2f} ms")
+        if result.wire:
+            totals = result.wire.get("totals", {})
+            print(" + WIRE LEDGER:")
+            print(
+                f"   goodput ratio: {result.wire.get('goodput_ratio')}"
+                f" ({totals.get('committed_payload_bytes', 0):,} committed B"
+                f" / {totals.get('out_bytes_total', 0):,} wire B;"
+                f" {totals.get('out_retransmit_bytes', 0):,} B retransmit)"
+            )
+            for t, d in sorted(result.wire.get("out", {}).items()):
+                print(
+                    f"   {t}: {d['frames']:,} frames / {d['bytes']:,} B out"
+                    + (
+                        f" (+{d['retransmit_bytes']:,} B retrans)"
+                        if d["retransmit_bytes"]
+                        else ""
+                    )
+                )
+            if "cert_sig_bytes_fraction" in result.wire:
+                print(
+                    "   cert signature bytes fraction: "
+                    f"{result.wire['cert_sig_bytes_fraction']}"
+                )
+            if "empty_cert_overhead_per_committed_byte" in result.wire:
+                print(
+                    "   empty-cert overhead per committed byte: "
+                    f"{result.wire['empty_cert_overhead_per_committed_byte']}"
+                )
+        if result.crypto:
+            print(" + CRYPTO LEDGER (verify ops by call site):")
+            for site, d in result.crypto.get("verify", {}).items():
+                print(
+                    f"   {site}: {d['ops']:,} ops / {d['calls']:,} calls"
+                    f" / {d['wall_s']:.2f} s wall"
+                    f" (mean batch {d['mean_batch']})"
+                )
+            cache = result.crypto.get("verify_cache", {})
+            print(
+                f"   verify cache: {cache.get('hits', 0):,} hits / "
+                f"{cache.get('misses', 0):,} misses"
+            )
         # Outside the stages guard: the disagreement matters MOST when the
         # stage join came up empty (missed flush, eviction).
         if result.metrics_disagreement is not None:

@@ -106,6 +106,12 @@ class ParseResult:
     # metrics_check.build_timeline): per-node TPS/round/commit-lag over
     # time, per-peer RTT matrix, and the /healthz verdicts at quiesce.
     timeline: Dict = field(default_factory=dict)
+    # Wire-goodput and crypto-cost ledgers joined across node snapshots
+    # (metrics_check.wire_crypto_summary): per-message-type bandwidth
+    # with retransmissions split out + goodput ratio, and per-call-site
+    # sign/verify attribution with the protocol-arithmetic cross-check.
+    wire: Dict = field(default_factory=dict)
+    crypto: Dict = field(default_factory=dict)
 
     def summary(self, rate: int, tx_size: int, nodes: int, workers: int) -> str:
         return (

@@ -276,6 +276,240 @@ def round_attribution(snapshots: List[dict]) -> dict:
     return out
 
 
+# -- wire-goodput & crypto-cost ledger joins ----------------------------------
+
+# An ed25519-signed vote inside a certificate costs 32 B (voter public
+# key) + 64 B (signature) on the wire; the embedded header adds one more
+# 64 B signature.  Certificates carry exactly quorum_threshold votes
+# (the VotesAggregator assembles at quorum and stops), so the signature
+# bytes of a cert frame are a pure function of the committee.
+_VOTE_WIRE_BYTES = 96
+_HEADER_SIG_BYTES = 64
+
+
+def _agg_counters(snapshots: List[dict]) -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    for snap in snapshots:
+        if not snap.get("enabled", True):
+            continue
+        for name, v in (snap.get("counters") or {}).items():
+            out[name] = out.get(name, 0) + (v or 0)
+    return out
+
+
+def _agg_histograms(snapshots: List[dict]) -> Dict[str, Tuple[float, int]]:
+    """name -> (sum, count) across snapshots."""
+    out: Dict[str, Tuple[float, int]] = {}
+    for snap in snapshots:
+        if not snap.get("enabled", True):
+            continue
+        for name, h in (snap.get("histograms") or {}).items():
+            if not isinstance(h, dict):
+                continue
+            s, c = out.get(name, (0.0, 0))
+            out[name] = (s + (h.get("sum") or 0.0), c + (h.get("count") or 0))
+    return out
+
+
+def wire_crypto_summary(
+    snapshots: List[dict],
+    committed_payload_bytes: int = 0,
+    quorum_weight: Optional[int] = None,
+) -> dict:
+    """Join the wire-goodput and crypto-cost ledgers across node
+    snapshots into the ``wire`` and ``crypto`` sections of the bench
+    JSON.  ``snapshots`` may be --metrics-path post-mortem files
+    (local_bench) or the scraper's final per-node samples (remote_bench)
+    — both carry the same counters/histograms shape.
+
+    Headline derived metrics:
+
+    - ``goodput_ratio`` — committed payload bytes ÷ total outbound wire
+      bytes (first transmissions + retransmissions, all nodes, all
+      planes).  This is the denominator ROADMAP items 1/3/5 need: the
+      paper reports goodput (committed payload), and the gap between it
+      and raw wire traffic is broadcast amplification + control plane +
+      retries.  Frame payload bytes only (length prefixes and tiny ACK
+      replies excluded on both directions alike).
+    - ``cert_sig_bytes_fraction`` — fraction of a certificate frame that
+      is signature material (quorum × 96 B votes + 64 B header sig ÷
+      mean cert frame size): the byte-level cost aggregate signatures
+      (ROADMAP item 5) would collapse to ~96 B.
+    - ``empty_cert_overhead_per_committed_byte`` — control-plane bytes
+      (header/vote/certificate frames) attributed to EMPTY rounds, per
+      committed payload byte: the "empty certs per committed byte"
+      number the min_header_delay default question reduces to (ROADMAP
+      item 3).
+
+    The crypto section's ``protocol_check`` cross-validates the ledger
+    against protocol arithmetic: one verified claim per peer vote, and
+    quorum+1 claims (2f+1 votes + 1 header sig) per certificate arriving
+    over the wire — within tolerance on a clean run; the verify cache
+    (re-deliveries) and in-flight teardown account for the residue.
+    """
+    counters = _agg_counters(snapshots)
+    hists = _agg_histograms(snapshots)
+
+    def typed(prefix: str) -> Dict[str, float]:
+        return {
+            name[len(prefix):]: v
+            for name, v in counters.items()
+            if name.startswith(prefix)
+        }
+
+    out_frames = typed("wire.out.frames.")
+    out_bytes = typed("wire.out.bytes.")
+    re_frames = typed("wire.out.retransmit_frames.")
+    re_bytes = typed("wire.out.retransmit_bytes.")
+    in_frames = typed("wire.in.frames.")
+    in_bytes = typed("wire.in.bytes.")
+
+    types = sorted(
+        set(out_bytes) | set(in_bytes) | set(re_bytes)
+    )
+    first_total = sum(out_bytes.values())
+    re_total = sum(re_bytes.values())
+    out_total = first_total + re_total
+    in_total = sum(in_bytes.values())
+    sender_total = (
+        counters.get("net.reliable.bytes_sent", 0)
+        + counters.get("net.simple.bytes_sent", 0)
+    )
+
+    wire: dict = {
+        "out": {
+            t: {
+                "frames": int(out_frames.get(t, 0)),
+                "bytes": int(out_bytes.get(t, 0)),
+                "retransmit_frames": int(re_frames.get(t, 0)),
+                "retransmit_bytes": int(re_bytes.get(t, 0)),
+            }
+            for t in types
+        },
+        "in": {
+            t: {
+                "frames": int(in_frames.get(t, 0)),
+                "bytes": int(in_bytes.get(t, 0)),
+            }
+            for t in types
+        },
+        "totals": {
+            "out_bytes": int(first_total),
+            "out_retransmit_bytes": int(re_total),
+            "out_bytes_total": int(out_total),
+            "in_bytes": int(in_total),
+            "committed_payload_bytes": int(committed_payload_bytes),
+            # Typed ledger bytes ÷ raw sender byte counters: ~1.0 means
+            # every sent byte carries a type label (the acceptance gate's
+            # "per-type wire bytes sum to total sender bytes").
+            "sender_coverage": (
+                round(out_total / sender_total, 4) if sender_total else None
+            ),
+        },
+        # Receiver-side bytes ÷ sender-side bytes (first + retransmit)
+        # per type: <1 when frames died with a connection (or a node was
+        # torn down before draining), >1 never (the receiver cannot see
+        # more than was written).
+        "recv_vs_sent": {
+            t: round(
+                in_bytes.get(t, 0)
+                / (out_bytes.get(t, 0) + re_bytes.get(t, 0)),
+                4,
+            )
+            for t in types
+            if out_bytes.get(t, 0) + re_bytes.get(t, 0) > 0
+        },
+    }
+    if out_total > 0:
+        wire["goodput_ratio"] = round(
+            committed_payload_bytes / out_total, 4
+        )
+    cert_bytes = out_bytes.get("certificate", 0)
+    cert_frames = out_frames.get("certificate", 0)
+    if quorum_weight and cert_frames:
+        sig_bytes = _VOTE_WIRE_BYTES * quorum_weight + _HEADER_SIG_BYTES
+        wire["cert_sig_bytes_per_cert"] = sig_bytes
+        wire["cert_sig_bytes_fraction"] = round(
+            sig_bytes / (cert_bytes / cert_frames), 4
+        )
+    empty_h = counters.get("primary.own_headers_empty", 0)
+    payload_h = counters.get("primary.own_headers_payload", 0)
+    wire["empty_headers"] = int(empty_h)
+    wire["payload_headers"] = int(payload_h)
+    control_bytes = sum(
+        out_bytes.get(t, 0) for t in ("header", "vote", "certificate")
+    )
+    if empty_h + payload_h > 0 and committed_payload_bytes > 0:
+        empty_fraction = empty_h / (empty_h + payload_h)
+        wire["empty_cert_overhead_per_committed_byte"] = round(
+            control_bytes * empty_fraction / committed_payload_bytes, 6
+        )
+
+    # -- crypto section -------------------------------------------------------
+
+    verify_sites: dict = {}
+    for site, ops in sorted(typed("crypto.verify.ops.").items()):
+        wall_s, calls = hists.get(f"crypto.verify.seconds.{site}", (0.0, 0))
+        bsum, bcount = hists.get(
+            f"crypto.verify.batch_size.{site}", (0.0, 0)
+        )
+        verify_sites[site] = {
+            "ops": int(ops),
+            "calls": int(calls),
+            "wall_s": round(wall_s, 3),
+            "mean_batch": round(bsum / bcount, 2) if bcount else None,
+        }
+    sign_sites: dict = {}
+    for site, ops in sorted(typed("crypto.sign.ops.").items()):
+        wall_s, _calls = hists.get(f"crypto.sign.seconds.{site}", (0.0, 0))
+        sign_sites[site] = {"ops": int(ops), "wall_s": round(wall_s, 3)}
+
+    claims = {
+        kind: int(v) for kind, v in typed("crypto.burst_claims.").items()
+    }
+    crypto: dict = {
+        "verify": verify_sites,
+        "sign": sign_sites,
+        "burst_claims": claims,
+        "verify_cache": {
+            "hits": int(counters.get("primary.verify_cache_hits", 0)),
+            "misses": int(counters.get("primary.verify_cache_misses", 0)),
+        },
+    }
+
+    # Protocol-arithmetic cross-check (see docstring).
+    votes_received = counters.get("primary.votes_received", 0)
+    late_votes = counters.get("primary.late_votes", 0)
+    own_headers = empty_h + payload_h
+    measured_vote_claims = claims.get("vote", 0) + (
+        verify_sites.get("vote", {}).get("ops", 0)
+    )
+    expected_vote_claims = votes_received - own_headers + late_votes
+    check: dict = {}
+    if expected_vote_claims > 0:
+        check["votes"] = {
+            "measured_claims": int(measured_vote_claims),
+            "expected_claims": int(expected_vote_claims),
+            "ratio": round(measured_vote_claims / expected_vote_claims, 4),
+        }
+    certs_in = counters.get("primary.certificates_processed", 0)
+    certs_own = counters.get("primary.certificates_formed", 0)
+    wire_certs = certs_in - certs_own
+    if quorum_weight and wire_certs > 0:
+        claims_per_cert = claims.get("certificate", 0) / wire_certs
+        check["certificates"] = {
+            "claims": claims.get("certificate", 0),
+            "wire_certs": int(wire_certs),
+            "claims_per_cert": round(claims_per_cert, 3),
+            # 2f+1 vote signatures + the embedded header's signature.
+            "expected_claims_per_cert": quorum_weight + 1,
+            "ratio": round(claims_per_cert / (quorum_weight + 1), 4),
+        }
+    if check:
+        crypto["protocol_check"] = check
+    return {"wire": wire, "crypto": crypto}
+
+
 # -- committee-wide timeline from scraped samples -----------------------------
 
 _PEER_RTT_PREFIX = "net.reliable.peer.rtt_seconds."

@@ -50,6 +50,7 @@ from benchmark.logs import parse_logs  # noqa: E402
 from benchmark.metrics_check import (  # noqa: E402
     build_timeline,
     check_quiesce_health,
+    wire_crypto_summary,
 )
 from benchmark.scraper import Scraper  # noqa: E402
 
@@ -437,6 +438,21 @@ def run_remote_bench(
     result.timeline = build_timeline(
         scraper.samples, interval_s=scrape_interval, healthz=healthz
     )
+    # Wire & crypto ledger sections from each node's LAST scraped sample
+    # (cumulative counters, so last ≈ whole run minus the post-scrape
+    # tail; the remote harness has no post-mortem snapshot files to
+    # read).  Same join as local_bench, same bench-JSON keys.
+    last_sample: dict = {}
+    for s in scraper.samples:
+        prev = last_sample.get(s["node"])
+        if prev is None or s["t"] >= prev["t"]:
+            last_sample[s["node"]] = s
+    wc = wire_crypto_summary(
+        list(last_sample.values()),
+        committed_payload_bytes=result.committed_bytes,
+        quorum_weight=committee.quorum_threshold(),
+    )
+    result.wire, result.crypto = wc["wire"], wc["crypto"]
     with open(f"{stage}/timeline.json", "w") as f:
         json.dump(result.timeline, f, indent=1)
     for r in runners:
@@ -540,6 +556,8 @@ def main() -> None:
                     "end_to_end_latency_ms": result.end_to_end_latency_ms,
                     "samples": result.samples,
                     "errors": result.errors[:10],
+                    "wire": result.wire,
+                    "crypto": result.crypto,
                     "timeline": result.timeline,
                 }
             )

@@ -1,0 +1,412 @@
+"""Cross-revision performance trajectory: read every bench artifact the
+repo carries, build per-metric revision series, and gate on regressions.
+
+Five ``BENCH_r*.json`` driver artifacts existed before this tool and
+nothing read them across revisions — the r05 e2e regression (0.71× the
+reference baseline vs r02's 0.92×, BENCH_r05 vs BENCH_r02) sat unlocated
+for five PRs because nothing watched the trajectory.  This tool is that
+watcher:
+
+- **collect**: ``BENCH_r*.json`` at the repo root (the driver's
+  ``{n, cmd, rc, parsed}`` wrapper) plus recognizable bench artifacts
+  under ``artifacts/`` (flat bench-result dicts and ``{"runs": [...]}``
+  A/B captures, e.g. ``metrics_stage_breakdown_r07.json``).  Everything
+  else under ``artifacts/`` is listed as skipped with a reason — a
+  partial or foreign artifact must never crash the gate (missing file,
+  malformed JSON, ``rc != 0``, zero-valued failed measurements: all
+  warn-and-skip).
+- **series**: tps / latency / per-stage pipeline legs / the wire &
+  crypto ledger headline metrics (goodput ratio, cert signature bytes
+  fraction, empty-cert overhead per committed byte), keyed by the
+  ``rNN`` revision in the filename.
+- **gate**: each gated metric's value per revision is compared against
+  the BEST of all prior revisions; a drop (rise, for lower-is-better
+  metrics) beyond the pinned tolerance is a regression.  Tolerances and
+  waivers are pinned in-repo (``benchmark/trajectory_gate.json``) so the
+  gate's meaning is versioned with the code; a waived regression stays
+  in the report but does not fail the gate (the r05 regression is waived
+  by name — ROADMAP item 3 owns recovering it, and a gate that fails on
+  five-PR-old history forever would just be muted).
+
+Exit status: 0 when no unwaived regression (skips and waived regressions
+only warn), 2 when the gate trips, 1 on usage errors.
+
+    python benchmark/trajectory.py --report .ci-artifacts/trajectory.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DEFAULT_GATE_CONFIG = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "trajectory_gate.json"
+)
+
+# Direction per metric family.  Metrics in neither set are tracked in
+# the report but never gated (informational: e.g. cert signature bytes
+# fraction moves with committee size, not code quality).
+HIGHER_BETTER = {
+    "end_to_end_tps",
+    "consensus_tps",
+    "vs_baseline",
+    "goodput_ratio",
+}
+LOWER_BETTER = {
+    "consensus_latency_ms",
+    "end_to_end_latency_ms",
+}
+# Pipeline stage legs (stage.<leg>) are lower-better but host-noise
+# swings them ±40% (r09/r10 artifacts), so they are tracked, not gated.
+_STAGE_PREFIX = "stage."
+
+_REV_RE = re.compile(r"r(\d+)")
+
+
+def parse_revision(path: str) -> Optional[str]:
+    """``rNN`` label from a filename, or None (no revision = no series
+    membership; the file is still reported as skipped)."""
+    m = _REV_RE.search(os.path.basename(path))
+    return f"r{int(m.group(1)):02d}" if m else None
+
+
+def _num(v) -> Optional[float]:
+    """A usable measurement: finite, strictly positive number.  Every
+    tracked metric is positive when valid — the r03/r04 driver files
+    published 0.0 for a failed measurement with a clean rc, which is
+    exactly the value a trajectory must not treat as 'we got slower'."""
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        return None
+    if v != v or v in (float("inf"), float("-inf")) or v <= 0:
+        return None
+    return float(v)
+
+
+def _bench_result_metrics(d: dict) -> Dict[str, float]:
+    """Metrics from one flat bench-result dict (bench.py's JSON line /
+    local_bench --json / a stage-breakdown artifact)."""
+    out: Dict[str, float] = {}
+    # The driver reports end_to_end OR consensus tps under "metric"/
+    # "value"; newer shapes carry the explicit keys too.
+    metric_name = d.get("metric") or ""
+    v = _num(d.get("value"))
+    if v is not None:
+        if metric_name.startswith("end_to_end_tps"):
+            out["end_to_end_tps"] = v
+        elif metric_name.startswith("consensus_tps"):
+            out["consensus_tps"] = v
+    # vs_baseline only when it is the E2E normalization: the driver
+    # falls back to value/consensus-baseline when the e2e join fails
+    # (bench.py), and mixing the two normalizations into one gated
+    # series would make best-of-prior comparisons apples-to-oranges.
+    if metric_name.startswith("end_to_end_tps"):
+        v = _num(d.get("vs_baseline"))
+        if v is not None:
+            out["vs_baseline"] = v
+    for key in (
+        "end_to_end_tps",
+        "consensus_tps",
+        "consensus_latency_ms",
+        "end_to_end_latency_ms",
+        "goodput_ratio",
+        "cert_sig_bytes_fraction",
+        "empty_cert_overhead_per_committed_byte",
+    ):
+        v = _num(d.get(key))
+        if v is not None:
+            out.setdefault(key, v)
+    # Wire/crypto sections when embedded whole (local_bench --json).
+    wire = d.get("wire")
+    if isinstance(wire, dict):
+        for key in (
+            "goodput_ratio",
+            "cert_sig_bytes_fraction",
+            "empty_cert_overhead_per_committed_byte",
+        ):
+            v = _num(wire.get(key))
+            if v is not None:
+                out.setdefault(key, v)
+    stages = d.get("stages_ms")
+    if isinstance(stages, dict):
+        for leg, ms in stages.items():
+            v = _num(ms)
+            if v is not None and leg != "trace_evictions":
+                out[f"{_STAGE_PREFIX}{leg}"] = v
+    return out
+
+
+def load_bench_file(path: str) -> Tuple[Optional[Dict[str, float]], str]:
+    """One artifact → (metrics, note).  ``metrics`` is None when the file
+    is skipped; ``note`` says why (or "ok")."""
+    base = os.path.basename(path)
+    if re.search(r"(_before|_pre|_baseline)\b|_before\.|_pre\.", base):
+        return None, "baseline/before arm (skipped by design)"
+    try:
+        with open(path) as f:
+            d = json.load(f)
+    except FileNotFoundError:
+        return None, "missing"
+    except (OSError, ValueError) as e:
+        return None, f"malformed: {e}"
+    if not isinstance(d, dict):
+        return None, "malformed: not a JSON object"
+
+    # Driver wrapper: {n, cmd, rc, tail, parsed}.
+    if "parsed" in d and "cmd" in d:
+        rc = d.get("rc")
+        if rc not in (0, None):
+            return None, f"rc={rc} (failed run, skipped)"
+        parsed = d.get("parsed")
+        if not isinstance(parsed, dict):
+            return None, "driver file without parsed JSON"
+        metrics = _bench_result_metrics(parsed)
+        if not metrics:
+            return None, "no usable measurement (failed run published zeros)"
+        return metrics, "ok"
+
+    # A/B capture: {"runs": [bench-result, ...]} — median by the primary
+    # throughput metric so one lucky/degraded run doesn't set the series.
+    runs = d.get("runs")
+    if isinstance(runs, list) and runs:
+        cands = [
+            (_bench_result_metrics(r), r) for r in runs if isinstance(r, dict)
+        ]
+        cands = [(m, r) for m, r in cands if m]
+        if not cands:
+            return None, "runs list without usable measurements"
+
+        def tput(m: Dict[str, float]) -> float:
+            return m.get("end_to_end_tps") or m.get("consensus_tps") or 0.0
+
+        cands.sort(key=lambda mr: tput(mr[0]))
+        metrics = cands[len(cands) // 2][0]
+        return metrics, f"ok (median of {len(cands)} runs)"
+
+    # Flat bench-result artifact.
+    metrics = _bench_result_metrics(d)
+    if metrics:
+        return metrics, "ok"
+    return None, "unrecognized shape (not a bench result)"
+
+
+def collect(root: str, quiet: bool = False) -> Tuple[dict, List[dict]]:
+    """Scan ``root`` → ({revision: {"metrics", "sources"}}, skipped)."""
+    paths = sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))) + sorted(
+        glob.glob(os.path.join(root, "artifacts", "*.json"))
+    )
+    revisions: Dict[str, dict] = {}
+    skipped: List[dict] = []
+    for path in paths:
+        rel = os.path.relpath(path, root)
+        rev = parse_revision(path)
+        metrics, note = load_bench_file(path)
+        if metrics is None or rev is None:
+            if rev is None and metrics is not None:
+                note = "no rNN revision in filename"
+            skipped.append({"file": rel, "reason": note})
+            if not quiet:
+                print(
+                    f"trajectory: skipping {rel}: {note}", file=sys.stderr
+                )
+            continue
+        # Only the root BENCH_r* driver artifacts share a workload (the
+        # per-run saturation probe), so only they feed the GATED series.
+        # artifacts/ captures run pinned, usually lower rates (e.g. the
+        # r07/r09 stage-breakdown attributions at rate 3000) — their
+        # numbers are cross-revision comparable with each other but not
+        # with the saturation probe, so they land in an `attr.`
+        # namespace the gate config never names.
+        if os.path.dirname(rel):
+            metrics = {f"attr.{n}": v for n, v in metrics.items()}
+        entry = revisions.setdefault(rev, {"metrics": {}, "sources": []})
+        entry["sources"].append(rel)
+        for name, v in metrics.items():
+            # First loader wins per (revision, metric): BENCH_r* files
+            # sort ahead of artifacts/, so the driver artifact is the
+            # canonical source and artifacts only add what it lacks.
+            entry["metrics"].setdefault(name, v)
+    return revisions, skipped
+
+
+def build_series(revisions: dict) -> Dict[str, List[Tuple[str, float]]]:
+    series: Dict[str, List[Tuple[str, float]]] = {}
+    for rev in sorted(revisions):
+        for name, v in revisions[rev]["metrics"].items():
+            series.setdefault(name, []).append((rev, v))
+    return series
+
+
+def load_gate_config(path: str) -> dict:
+    """Pinned tolerances + waivers.  A missing/broken config falls back
+    to gating nothing (loudly): a misplaced file must not turn the gate
+    into a random failure generator."""
+    try:
+        with open(path) as f:
+            cfg = json.load(f)
+        if not isinstance(cfg, dict):
+            raise ValueError("gate config must be a JSON object")
+        return cfg
+    except (OSError, ValueError) as e:
+        print(
+            f"trajectory: WARNING: gate config {path} unusable ({e}); "
+            "gating disabled for this run",
+            file=sys.stderr,
+        )
+        return {"tolerances": {}, "waivers": []}
+
+
+def find_regressions(
+    series: Dict[str, List[Tuple[str, float]]], config: dict
+) -> List[dict]:
+    """Every gated metric's value vs the best of all PRIOR revisions.
+    Only metrics named in the config's ``tolerances`` are gated — the
+    tolerance is pinned per metric, in-repo, on purpose."""
+    tolerances: dict = config.get("tolerances") or {}
+    waivers: List[dict] = config.get("waivers") or []
+    out: List[dict] = []
+    for name, points in sorted(series.items()):
+        tol = tolerances.get(name)
+        if tol is None or len(points) < 2:
+            continue
+        higher = name in HIGHER_BETTER
+        if not higher and name not in LOWER_BETTER:
+            continue  # informational metric; direction undefined
+        best_v, best_rev = points[0][1], points[0][0]
+        for rev, v in points[1:]:
+            if higher:
+                regressed = v < best_v * (1 - tol)
+                change = v / best_v - 1
+            else:
+                regressed = v > best_v * (1 + tol)
+                change = v / best_v - 1
+            if regressed:
+                waiver = next(
+                    (
+                        w
+                        for w in waivers
+                        if w.get("metric") == name
+                        and w.get("revision") == rev
+                    ),
+                    None,
+                )
+                out.append(
+                    {
+                        "metric": name,
+                        "revision": rev,
+                        "value": v,
+                        "baseline": best_v,
+                        "baseline_revision": best_rev,
+                        "change_pct": round(100 * change, 1),
+                        "tolerance_pct": round(100 * tol, 1),
+                        "waived": waiver is not None,
+                        **(
+                            {"waiver_reason": waiver.get("reason")}
+                            if waiver
+                            else {}
+                        ),
+                    }
+                )
+            if (higher and v > best_v) or (not higher and v < best_v):
+                best_v, best_rev = v, rev
+    return out
+
+
+def render_table(series: Dict[str, List[Tuple[str, float]]]) -> str:
+    revs = sorted({rev for pts in series.values() for rev, _ in pts})
+    lines = []
+    name_w = max((len(n) for n in series), default=6)
+    header = "metric".ljust(name_w) + "".join(f"{r:>12}" for r in revs)
+    lines.append(header)
+    lines.append("-" * len(header))
+    for name in sorted(series):
+        vals = dict(series[name])
+        row = name.ljust(name_w)
+        for r in revs:
+            v = vals.get(r)
+            row += f"{v:>12.4g}" if v is not None else f"{'—':>12}"
+        lines.append(row)
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--root", default=REPO, help="repo root to scan")
+    ap.add_argument(
+        "--gate-config",
+        default=DEFAULT_GATE_CONFIG,
+        help="pinned tolerances + waivers (benchmark/trajectory_gate.json)",
+    )
+    ap.add_argument(
+        "--report", default=None, help="write the full JSON report here"
+    )
+    ap.add_argument(
+        "--no-gate",
+        action="store_true",
+        help="report only; exit 0 even on unwaived regressions",
+    )
+    ap.add_argument("--quiet", action="store_true")
+    args = ap.parse_args(argv)
+
+    revisions, skipped = collect(args.root, quiet=args.quiet)
+    series = build_series(revisions)
+    config = load_gate_config(args.gate_config)
+    regressions = find_regressions(series, config)
+    unwaived = [r for r in regressions if not r["waived"]]
+
+    report = {
+        "revisions": {
+            rev: revisions[rev] for rev in sorted(revisions)
+        },
+        "series": {
+            name: [[rev, v] for rev, v in pts]
+            for name, pts in sorted(series.items())
+        },
+        "regressions": regressions,
+        "skipped": skipped,
+        "gate": {
+            "config": args.gate_config,
+            "tolerances": config.get("tolerances") or {},
+            "unwaived_regressions": len(unwaived),
+        },
+    }
+    if args.report:
+        os.makedirs(os.path.dirname(args.report) or ".", exist_ok=True)
+        with open(args.report, "w") as f:
+            json.dump(report, f, indent=1)
+
+    if not args.quiet:
+        if series:
+            print(render_table(series))
+        else:
+            print("trajectory: no usable bench artifacts found")
+        for r in regressions:
+            tag = "WAIVED" if r["waived"] else "REGRESSION"
+            line = (
+                f"{tag}: {r['metric']} {r['revision']} = {r['value']:g} "
+                f"vs {r['baseline']:g} at {r['baseline_revision']} "
+                f"({r['change_pct']:+.1f}%, tolerance "
+                f"±{r['tolerance_pct']:.0f}%)"
+            )
+            if r["waived"]:
+                line += f" — {r.get('waiver_reason')}"
+            print(line, file=sys.stderr if not r["waived"] else sys.stdout)
+
+    if unwaived and not args.no_gate:
+        print(
+            f"trajectory gate FAILED: {len(unwaived)} unwaived "
+            "regression(s) beyond pinned tolerance",
+            file=sys.stderr,
+        )
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -9,10 +9,50 @@ a vmapped JAX verifier (narwhal_tpu/ops/ed25519.py) in one dispatch.
 
 from __future__ import annotations
 
-from typing import List, Sequence
+import time
+from typing import Dict, List, Sequence, Tuple
 
+from .. import metrics
 from .digest import Digest
 from .keys import PublicKey, Signature, cpu_verify
+
+# -- crypto-cost ledger -------------------------------------------------------
+#
+# Every module-level verify entry point below is labelled with its CALL
+# SITE so the bench's `crypto` section can attribute where verification
+# ops (and their wall time) come from:
+#
+#   header / vote / certificate  inline sanitization (Header.verify,
+#                                Vote.verify, Certificate.verify — the
+#                                serial path)
+#   batch_burst                  Core's accumulate→averify→replay seam
+#                                (the batched path the ROADMAP item-1 A/B
+#                                must show absorbing the serial ops)
+#
+# Per site: `crypto.verify.ops.<site>` (signature checks performed),
+# `crypto.verify.seconds.<site>` (wall time per CALL — for the async
+# batched path this includes event-loop yields/device round-trip, which
+# is exactly the latency the caller pays), and
+# `crypto.verify.batch_size.<site>` (ops per call — the serial→batched
+# conversion shows up as mass moving to higher buckets).
+# Instrumentation lives HERE, on the module seam, so both the CPU and
+# TPU backends are covered and backend-internal chunking is not
+# double-counted.
+
+_verify_instruments_cache: Dict[str, Tuple] = {}
+
+
+def _verify_instruments(site: str):
+    inst = _verify_instruments_cache.get(site)
+    if inst is None:
+        inst = _verify_instruments_cache[site] = (
+            metrics.counter(f"crypto.verify.ops.{site}"),
+            metrics.histogram(f"crypto.verify.seconds.{site}"),
+            metrics.histogram(
+                f"crypto.verify.batch_size.{site}", metrics.COUNT_BUCKETS
+            ),
+        )
+    return inst
 
 
 class CpuBackend:
@@ -81,27 +121,45 @@ def get_backend():
     return _backend
 
 
-def verify(message: bytes, key: PublicKey, sig: Signature) -> bool:
-    return _backend.verify(message, key, sig)
+def verify(
+    message: bytes, key: PublicKey, sig: Signature, site: str = "other"
+) -> bool:
+    ops, secs, sizes = _verify_instruments(site)
+    t0 = time.perf_counter()
+    try:
+        return _backend.verify(message, key, sig)
+    finally:
+        ops.inc()
+        sizes.observe(1)
+        secs.observe(time.perf_counter() - t0)
 
 
 def verify_batch_mask(
     messages: Sequence[bytes],
     keys: Sequence[PublicKey],
     sigs: Sequence[Signature],
+    site: str = "other",
 ) -> List[bool]:
     """Per-item validity mask for a batch of (message, key, signature)."""
     if not (len(messages) == len(keys) == len(sigs)):
         raise ValueError("verify_batch: length mismatch")
     if not messages:
         return []
-    return list(_backend.verify_batch_mask(messages, keys, sigs))
+    ops, secs, sizes = _verify_instruments(site)
+    t0 = time.perf_counter()
+    try:
+        return list(_backend.verify_batch_mask(messages, keys, sigs))
+    finally:
+        ops.inc(len(messages))
+        sizes.observe(len(messages))
+        secs.observe(time.perf_counter() - t0)
 
 
 async def averify_batch_mask(
     messages: Sequence[bytes],
     keys: Sequence[PublicKey],
     sigs: Sequence[Signature],
+    site: str = "other",
 ) -> List[bool]:
     """Async verify_batch_mask: the TPU backend runs the device round trip
     in an executor thread so the node's event loop (networking, proposer
@@ -111,13 +169,23 @@ async def averify_batch_mask(
         raise ValueError("verify_batch: length mismatch")
     if not messages:
         return []
-    return list(await _backend.averify_batch_mask(messages, keys, sigs))
+    ops, secs, sizes = _verify_instruments(site)
+    t0 = time.perf_counter()
+    try:
+        return list(await _backend.averify_batch_mask(messages, keys, sigs))
+    finally:
+        # Wall time across the await: includes event-loop yields and the
+        # device round trip — the latency the calling burst actually pays.
+        ops.inc(len(messages))
+        sizes.observe(len(messages))
+        secs.observe(time.perf_counter() - t0)
 
 
 def verify_batch(
     digest: Digest,
     keys: Sequence[PublicKey],
     sigs: Sequence[Signature],
+    site: str = "other",
 ) -> bool:
     """All-or-nothing batch verification of many signatures over ONE digest —
     the certificate-quorum check (reference primary/src/messages.rs:189-215).
@@ -125,4 +193,4 @@ def verify_batch(
     if not keys:
         return False
     msgs = [bytes(digest)] * len(keys)
-    return all(verify_batch_mask(msgs, keys, sigs))
+    return all(verify_batch_mask(msgs, keys, sigs, site=site))

@@ -42,8 +42,26 @@ except ImportError:  # minimal container: pure-Python fallback
         stacklevel=2,
     )
 
+import time as _time
+
+from .. import metrics as _metrics
 from . import _ed25519_py
 from .digest import Digest
+
+# Crypto-cost ledger, signing side: op counts and wall time per call
+# site ("header" / "vote" via SignatureService, "other" for direct
+# callers).  Memoized like the verify-side instruments in backend.py.
+_sign_instruments_cache: dict = {}
+
+
+def _sign_instruments(site: str):
+    inst = _sign_instruments_cache.get(site)
+    if inst is None:
+        inst = _sign_instruments_cache[site] = (
+            _metrics.counter(f"crypto.sign.ops.{site}"),
+            _metrics.histogram(f"crypto.sign.seconds.{site}"),
+        )
+    return inst
 
 
 class PublicKey(bytes):
@@ -147,13 +165,19 @@ class KeyPair:
             pk = _ed25519_py.secret_to_public(seed)
         return cls(PublicKey(pk), SecretKey(seed))
 
-    def sign(self, digest: Digest) -> Signature:
-        if self._sk is not None:
-            return Signature(self._sk.sign(bytes(digest)))
-        a, prefix, pub = self._py_expanded
-        return Signature(
-            _ed25519_py.sign_expanded(a, prefix, pub, bytes(digest))
-        )
+    def sign(self, digest: Digest, site: str = "other") -> Signature:
+        ops, secs = _sign_instruments(site)
+        t0 = _time.perf_counter()
+        try:
+            if self._sk is not None:
+                return Signature(self._sk.sign(bytes(digest)))
+            a, prefix, pub = self._py_expanded
+            return Signature(
+                _ed25519_py.sign_expanded(a, prefix, pub, bytes(digest))
+            )
+        finally:
+            ops.inc()
+            secs.observe(_time.perf_counter() - t0)
 
     # --- JSON file import/export (reference config/src/lib.rs:28-56) ---
 

@@ -34,24 +34,29 @@ class SignatureService:
 
     async def _run(self, queue: asyncio.Queue) -> None:
         while True:
-            digest, fut = await queue.get()
+            digest, fut, site = await queue.get()
             if fut.cancelled():
                 continue
             try:
-                fut.set_result(self._keypair.sign(digest))
+                fut.set_result(self._keypair.sign(digest, site=site))
             except Exception as e:  # propagate instead of wedging the actor
                 fut.set_exception(e)
 
-    async def request_signature(self, digest: Digest) -> Signature:
+    async def request_signature(
+        self, digest: Digest, site: str = "other"
+    ) -> Signature:
+        """``site`` labels the op in the crypto-cost ledger (the caller
+        knows what the digest is — "header" for Header.new, "vote" for
+        Vote.new)."""
         self._ensure_started()
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        item: Tuple[Digest, asyncio.Future] = (digest, fut)
+        item: Tuple[Digest, asyncio.Future, str] = (digest, fut, site)
         await self._queue.put(item)
         return await fut
 
-    def sign_now(self, digest: Digest) -> Signature:
+    def sign_now(self, digest: Digest, site: str = "other") -> Signature:
         """Synchronous signing for non-async contexts (tests, tools)."""
-        return self._keypair.sign(digest)
+        return self._keypair.sign(digest, site=site)
 
     def close(self) -> None:
         if self._task is not None and not self._task.done():

@@ -225,14 +225,20 @@ class ByzantineCore(Core):
             else None
         )
         if twin is None:
-            return self.network.broadcast(self.others_addresses, message)
+            return self.network.broadcast(
+                self.others_addresses, message, msg_type="header"
+            )
         real_share, twin_share = plan.split_peers(
             self.others_addresses,
             self.committee.quorum_threshold() - 1,
         )
-        handlers = self.network.broadcast(real_share, message)
+        handlers = self.network.broadcast(
+            real_share, message, msg_type="header"
+        )
         handlers.extend(
-            self.network.broadcast(twin_share, encode_primary_message(twin))
+            self.network.broadcast(
+                twin_share, encode_primary_message(twin), msg_type="header"
+            )
         )
         self._m_equivocated.inc()
         log.warning(
@@ -300,6 +306,8 @@ class ByzantineCore(Core):
                     f.cancel()
                 self._replay_futs = []
             self._replay_futs.extend(
-                self.network.broadcast(self.others_addresses, message)
+                self.network.broadcast(
+                    self.others_addresses, message, msg_type="certificate"
+                )
             )
             self._m_replays.inc()

@@ -138,3 +138,42 @@ def decode_worker_primary_message(data: bytes) -> BatchDigestMessage:
     worker_id = r.u32()
     r.expect_done()
     return BatchDigestMessage(digest, worker_id, tag == WP_OUR_BATCH)
+
+
+# --- wire-type classification (wire-goodput ledger) --------------------------
+#
+# Each plane has its own socket and an independent u8 tag space, so a
+# frame's message type is (plane, first byte).  The receivers hand their
+# plane's classifier to network.Receiver, which accounts every inbound
+# frame per type in the metrics WireLedger; senders pass the type
+# explicitly at the call site that just encoded the message.  One shared
+# name space across planes (a "batch" is a batch whichever socket carried
+# it) so the bench's wire section aggregates cleanly.
+
+WORKER_FRAME_TYPES = {
+    WORKER_BATCH: "batch",
+    WORKER_BATCH_REQUEST: "batch_request",
+}
+
+PRIMARY_WORKER_FRAME_TYPES = {
+    PW_SYNCHRONIZE: "synchronize",
+    PW_CLEANUP: "cleanup",
+}
+
+WORKER_PRIMARY_FRAME_TYPES = {
+    WP_OUR_BATCH: "batch_digest",
+    WP_OTHERS_BATCH: "batch_digest",
+}
+
+
+def frame_classifier(tag_map):
+    """A ``bytes -> type-name`` classifier over one plane's tag space
+    (unknown/empty frames classify as "unknown", never raise — the
+    ledger must account garbage too, the handler rejects it later)."""
+
+    def classify(data: bytes) -> str:
+        if not data:
+            return "unknown"
+        return tag_map.get(data[0], "unknown")
+
+    return classify

@@ -238,6 +238,104 @@ class TraceTable:
             entry.setdefault(k, v)
 
 
+class WireLedger:
+    """Per-(direction, message-type, peer) wire accounting.
+
+    The network layer moves opaque frames; the serialization seam
+    (narwhal_tpu/messages.py, primary/messages.py) is where bytes acquire
+    a protocol meaning — so senders/receivers are handed the message type
+    explicitly (senders at the call site that just encoded it, receivers
+    via a plane-appropriate tag classifier) and this ledger turns every
+    frame into four numbers:
+
+    - ``wire.out.frames.<type>`` / ``wire.out.bytes.<type>`` — FIRST
+      transmissions only;
+    - ``wire.out.retransmit_frames.<type>`` / ``_bytes.<type>`` — every
+      re-write of an un-ACKed frame after a reconnect (ReliableSender).
+      Kept apart so goodput math can never confuse "bytes the protocol
+      needed" with "bytes a flapping link cost" — the denominator of the
+      goodput ratio uses their SUM, the per-type protocol cost uses only
+      the first-transmission counters;
+    - ``wire.in.frames.<type>`` / ``wire.in.bytes.<type>`` — receiver
+      side, which is how sender-vs-receiver totals reconcile per type.
+
+    Per-peer detail rides in one ``wire.peers`` detail_fn (snapshot-only,
+    excluded from Prometheus):
+    ``{"out"|"in": {type: {peer: [frames, bytes, re_frames, re_bytes]}}}``.
+
+    Counted bytes are frame PAYLOAD bytes (``len(data)``): the framing
+    length prefix and the tiny ACK replies are excluded on both sides,
+    so the two directions measure the same thing.
+    """
+
+    __slots__ = ("registry", "peers", "_flat")
+
+    def __init__(self, reg: "Registry") -> None:
+        self.registry = reg
+        # direction -> type -> peer -> [frames, bytes, re_frames, re_bytes]
+        self.peers: Dict[str, Dict[str, Dict[str, List[int]]]] = {
+            "out": {},
+            "in": {},
+        }
+        # (direction, type, retransmit) -> (frames Counter, bytes Counter)
+        self._flat: Dict[Tuple[str, str, bool], Tuple[Counter, Counter]] = {}
+        if reg.enabled:
+            reg.detail_fn("wire.peers", lambda: self.peers)
+
+    def _counters(
+        self, direction: str, msg_type: str, retransmit: bool
+    ) -> Tuple[Counter, Counter]:
+        key = (direction, msg_type, retransmit)
+        pair = self._flat.get(key)
+        if pair is None:
+            stem = (
+                f"wire.{direction}.retransmit"
+                if retransmit
+                else f"wire.{direction}"
+            )
+            pair = self._flat[key] = (
+                self.registry.counter(
+                    f"{stem}_frames.{msg_type}"
+                    if retransmit
+                    else f"{stem}.frames.{msg_type}"
+                ),
+                self.registry.counter(
+                    f"{stem}_bytes.{msg_type}"
+                    if retransmit
+                    else f"{stem}.bytes.{msg_type}"
+                ),
+            )
+        return pair
+
+    def account(
+        self,
+        direction: str,
+        msg_type: str,
+        peer: str,
+        nbytes: int,
+        retransmit: bool = False,
+    ) -> None:
+        if not self.registry.enabled:
+            return
+        frames, nbytes_c = self._counters(direction, msg_type, retransmit)
+        frames.inc()
+        nbytes_c.inc(nbytes)
+        cell = (
+            self.peers[direction]
+            .setdefault(msg_type, {})
+            .setdefault(peer, [0, 0, 0, 0])
+        )
+        idx = 2 if retransmit else 0
+        cell[idx] += 1
+        cell[idx + 1] += nbytes
+
+    def reset(self) -> None:
+        for d in self.peers.values():
+            d.clear()
+        # Flat counters keep identity (they live in the registry's pools
+        # and are zeroed by Registry.reset's counter sweep).
+
+
 class _Null:
     """Shared no-op instrument for the stubbed registry (NARWHAL_METRICS=0).
     One class serves every instrument type: all mutators are no-ops and all
@@ -302,6 +400,9 @@ class Registry:
         # snapshots then carry a `health` section and the MetricsServer
         # answers /healthz from it.
         self.health: Optional["HealthMonitor"] = None
+        # Per-(direction, message-type, peer) wire accounting; the
+        # network senders/receiver feed it (see WireLedger).
+        self.wire = WireLedger(self)
         if enabled:
             self.gauge_fn(
                 "metrics.trace_evictions", lambda: self.trace.evictions
@@ -364,6 +465,7 @@ class Registry:
             self.trace.evictions = 0
             self.round_trace.entries.clear()
             self.round_trace.evictions = 0
+        self.wire.reset()
         # A monitor attached by a previous test would otherwise keep
         # reporting rule state over the zeroed instruments.
         self.health = None
@@ -606,7 +708,15 @@ def default_rules(env: Optional[Mapping[str, str]] = None) -> List[HealthRule]:
     quorum_wedge_s = f("NARWHAL_HEALTH_QUORUM_WEDGE_S", 10)
     vote_window = f("NARWHAL_HEALTH_VOTE_SILENCE_WINDOW_S", 8)
     vote_min_rounds = f("NARWHAL_HEALTH_VOTE_SILENCE_MIN_ROUNDS", 3)
-    stale_rate_max = f("NARWHAL_HEALTH_STALE_RATE", 2)
+    # 6/s, not the original 2/s: a node catching up after a healed
+    # partition replays its backlog at a measured 2.4-2.9 stale
+    # messages/s (the wan_partition_heal scenario's healed node FIRED
+    # transiently at the old default — ROADMAP item 4's named
+    # follow-up), while the replay-flood attack this rule exists for
+    # measures an order of magnitude higher (byz_replay_stale re-sends
+    # at 10/s per peer).  6/s sits ~2x above the heal burst and still
+    # comfortably under the attack floor.
+    stale_rate_max = f("NARWHAL_HEALTH_STALE_RATE", 6)
     stale_window = f("NARWHAL_HEALTH_STALE_WINDOW_S", 5)
 
     def commit_lag(ctx: HealthContext) -> Dict[str, dict]:
@@ -1017,6 +1127,22 @@ def trace() -> TraceTable:
 
 def round_trace() -> TraceTable:
     return _REGISTRY.round_trace  # type: ignore[return-value]
+
+
+def wire() -> WireLedger:
+    return _REGISTRY.wire
+
+
+def wire_account(
+    direction: str,
+    msg_type: str,
+    peer: str,
+    nbytes: int,
+    retransmit: bool = False,
+) -> None:
+    """Module-level convenience for the network layer (one call per
+    frame; no-op when the registry is stubbed)."""
+    _REGISTRY.wire.account(direction, msg_type, peer, nbytes, retransmit)
 
 
 # -- snapshot writer ----------------------------------------------------------

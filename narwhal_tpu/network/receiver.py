@@ -44,18 +44,31 @@ class MessageHandler(Protocol):
 
 
 class Receiver:
-    """Binds `address` and dispatches every inbound frame to `handler`."""
+    """Binds `address` and dispatches every inbound frame to `handler`.
 
-    def __init__(self, address: str, handler: MessageHandler) -> None:
+    ``classify`` (optional, ``bytes -> type-name``) is the plane's frame
+    classifier (messages.frame_classifier over the plane's tag space):
+    when present, every inbound frame is ALSO accounted per message type
+    in the wire-goodput ledger — the receiver side of the sender/receiver
+    reconciliation the bench's ``wire`` section reports.  Without it,
+    frames are accounted under the "unframed" type so inbound totals
+    still cover every byte."""
+
+    def __init__(
+        self, address: str, handler: MessageHandler, classify=None
+    ) -> None:
         self.address = address
         self.handler = handler
+        self.classify = classify
         self._server: asyncio.AbstractServer | None = None
         self._connections: set = set()
         self._closing = False
 
     @classmethod
-    async def spawn(cls, address: str, handler: MessageHandler) -> "Receiver":
-        self = cls(address, handler)
+    async def spawn(
+        cls, address: str, handler: MessageHandler, classify=None
+    ) -> "Receiver":
+        self = cls(address, handler, classify)
         host, port = parse_address(address)
         # NARWHAL_BIND_ANY=1: listen on 0.0.0.0 with the committee port
         # instead of the advertised IP.  Multi-host deployments need this
@@ -95,6 +108,11 @@ class Receiver:
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
         peer = writer.get_extra_info("peername")
+        # Per-peer attribution is the source IP only: the source port is
+        # ephemeral, so peers are indistinguishable on localhost — the
+        # outbound side (which knows the dialed address) carries the
+        # precise per-peer split.
+        peer_ip = peer[0] if isinstance(peer, tuple) else str(peer)
         tune_writer(writer)
         w = Writer(writer)
         try:
@@ -102,6 +120,12 @@ class Receiver:
                 message = await read_frame(reader)
                 _m_frames_in.inc()
                 _m_bytes_in.inc(len(message))
+                metrics.wire_account(
+                    "in",
+                    self.classify(message) if self.classify else "unframed",
+                    peer_ip,
+                    len(message),
+                )
                 await self.handler.dispatch(w, message)
         except (asyncio.IncompleteReadError, ConnectionError):
             pass  # peer closed

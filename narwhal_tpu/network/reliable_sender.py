@@ -83,10 +83,28 @@ def next_backoff(
     sleep = delay * (0.5 + 0.5 * rng.random())
     return sleep, min(delay * 2, cap)
 
-_Item = Tuple[bytes, asyncio.Future]
-# Pending (written, awaiting ACK) items additionally carry the write
-# timestamp, so each ACK yields a per-peer round-trip observation.
-_Pending = Tuple[bytes, asyncio.Future, float]
+class _Msg:
+    """One queued message, across its whole delivery lifecycle (buffer →
+    pending → possibly re-buffered after a lost connection).
+
+    ``accounted`` tracks whether a COMPLETED write of this frame has
+    already been charged to the wire ledger's per-type first-transmission
+    counters: the first completed write is the protocol's cost, every
+    later completed write is a retransmission (separate counters, so
+    per-type protocol bytes are never inflated by link flaps — and a
+    frame whose first write attempt died mid-stream still gets exactly
+    one first-transmission count when it finally lands).  ``t0`` is the
+    write timestamp while pending, for the per-peer ACK-RTT histogram.
+    """
+
+    __slots__ = ("data", "fut", "msg_type", "accounted", "t0")
+
+    def __init__(self, data: bytes, fut: asyncio.Future, msg_type: str):
+        self.data = data
+        self.fut = fut
+        self.msg_type = msg_type
+        self.accounted = False
+        self.t0 = 0.0
 
 # Counters are shared by every ReliableSender in the process (one registry
 # per process); the per-peer detail below disaggregates when needed.
@@ -158,8 +176,8 @@ class _Connection:
 
     def __init__(self, address: str) -> None:
         self.address = address
-        self.buffer: Deque[_Item] = collections.deque()
-        self.pending: Deque[_Pending] = collections.deque()
+        self.buffer: Deque[_Msg] = collections.deque()
+        self.pending: Deque[_Msg] = collections.deque()
         self.wakeup = asyncio.Event()
         self.backing_off = False  # reconnect backoff state (metrics gauge)
         self.failures = 0  # consecutive connect failures (health rule input)
@@ -171,15 +189,15 @@ class _Connection:
         ) = _peer_instruments(address)
         self.task = asyncio.get_running_loop().create_task(self._keep_alive())
 
-    def push(self, data: bytes, fut: asyncio.Future) -> None:
-        self.buffer.append((data, fut))
+    def push(self, data: bytes, fut: asyncio.Future, msg_type: str) -> None:
+        self.buffer.append(_Msg(data, fut, msg_type))
         self.wakeup.set()
 
     def abort_all(self) -> None:
         """Fail every outstanding delivery (sender shutdown)."""
         for item in list(self.pending) + list(self.buffer):
-            if not item[1].done():
-                item[1].cancel()
+            if not item.fut.done():
+                item.fut.cancel()
         self.pending.clear()
         self.buffer.clear()
 
@@ -187,9 +205,9 @@ class _Connection:
         """Move un-ACKed items back to the front of the buffer, oldest first,
         dropping messages whose caller gave up (cancelled future)."""
         while self.pending:
-            data, fut, _t0 = self.pending.pop()
-            if not fut.cancelled():
-                self.buffer.appendleft((data, fut))
+            item = self.pending.pop()
+            if not item.fut.cancelled():
+                self.buffer.appendleft(item)
                 # Written once, un-ACKed, will be written again: that is a
                 # retransmission, the signal a flapping/slow peer leaves.
                 _m_retrans.inc()
@@ -246,19 +264,25 @@ class _Connection:
         async def write_loop() -> None:
             while True:
                 while self.buffer:
-                    data, fut = self.buffer.popleft()
-                    if fut.cancelled():
+                    item = self.buffer.popleft()
+                    if item.fut.cancelled():
                         continue
                     # Into `pending` BEFORE the await: if the write (or this
                     # task) dies mid-frame, reconnect retransmits it rather
                     # than losing the message and wedging its future.
-                    self.pending.append((data, fut, loop.time()))
-                    await write_frame(writer, data)
+                    item.t0 = loop.time()
+                    self.pending.append(item)
+                    await write_frame(writer, item.data)
                     # Counted after the write returns (same convention as
                     # SimpleSender): a frame lost to a mid-write disconnect
                     # is not "sent" — its rewrite after reconnect is.
                     _m_frames.inc()
-                    _m_bytes.inc(len(data))
+                    _m_bytes.inc(len(item.data))
+                    metrics.wire_account(
+                        "out", item.msg_type, self.address, len(item.data),
+                        retransmit=item.accounted,
+                    )
+                    item.accounted = True
                 self.wakeup.clear()
                 await self.wakeup.wait()
 
@@ -269,10 +293,10 @@ class _Connection:
                 # Exactly one pending entry per ACK frame — the peer ACKs
                 # everything we wrote, including since-cancelled messages.
                 if self.pending:
-                    _, fut, t0 = self.pending.popleft()
-                    self._m_rtt.observe(loop.time() - t0)
-                    if not fut.done():
-                        fut.set_result(ack)
+                    item = self.pending.popleft()
+                    self._m_rtt.observe(loop.time() - item.t0)
+                    if not item.fut.done():
+                        item.fut.set_result(ack)
 
         w = asyncio.get_running_loop().create_task(write_loop())
         r = asyncio.get_running_loop().create_task(read_loop())
@@ -302,28 +326,36 @@ class ReliableSender:
             self._connections[address] = conn
         return conn
 
-    def send(self, address: str, data: bytes) -> asyncio.Future:
+    def send(
+        self, address: str, data: bytes, msg_type: str = "other"
+    ) -> asyncio.Future:
         """Queue `data` for delivery; the returned future resolves with the
-        peer's ACK payload.  Cancel it to abandon delivery."""
+        peer's ACK payload.  Cancel it to abandon delivery.  ``msg_type``
+        labels the frame in the wire-goodput ledger (the caller just
+        encoded the message, so it knows; see metrics.WireLedger)."""
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         if len(data) > MAX_FRAME:
             fut.set_exception(
                 ValueError(f"message of {len(data)} bytes exceeds MAX_FRAME")
             )
             return fut
-        self._connection(address).push(data, fut)
+        self._connection(address).push(data, fut, msg_type)
         return fut
 
     def broadcast(
-        self, addresses: Sequence[str], data: bytes
+        self, addresses: Sequence[str], data: bytes, msg_type: str = "other"
     ) -> List[asyncio.Future]:
-        return [self.send(addr, data) for addr in addresses]
+        return [self.send(addr, data, msg_type) for addr in addresses]
 
     def lucky_broadcast(
-        self, addresses: Sequence[str], data: bytes, nodes: int
+        self,
+        addresses: Sequence[str],
+        data: bytes,
+        nodes: int,
+        msg_type: str = "other",
     ) -> List[asyncio.Future]:
         """Send to `nodes` random peers (reference reliable_sender.rs:91-100)."""
-        return self.broadcast(sample_peers(addresses, nodes), data)
+        return self.broadcast(sample_peers(addresses, nodes), data, msg_type)
 
     def close(self) -> None:
         for conn in self._connections.values():

@@ -40,7 +40,7 @@ class _Peer:
     async def _run(self) -> None:
         host, port = parse_address(self.address)
         while True:
-            data = await self.queue.get()
+            data, msg_type = await self.queue.get()
             try:
                 # Fault-injection partition shim: best-effort semantics —
                 # a partitioned peer's message is a visible drop.
@@ -66,7 +66,10 @@ class _Peer:
                     # (this sender's whole contract is visible loss).
                     _m_frames.inc()
                     _m_bytes.inc(len(data))
-                    data = await self.queue.get()
+                    metrics.wire_account(
+                        "out", msg_type, self.address, len(data)
+                    )
+                    data, msg_type = await self.queue.get()
             except (ConnectionError, OSError) as e:
                 _m_dropped.inc()
                 log.debug("SimpleSender: lost %s: %s", self.address, e)
@@ -87,26 +90,36 @@ class SimpleSender:
     def __init__(self) -> None:
         self._peers: Dict[str, _Peer] = {}
 
-    def send(self, address: str, data: bytes) -> None:
+    def send(
+        self, address: str, data: bytes, msg_type: str = "other"
+    ) -> None:
+        """``msg_type`` labels the frame in the wire-goodput ledger (the
+        caller just encoded the message, so it knows)."""
         peer = self._peers.get(address)
         if peer is None or peer.task.done():
             peer = _Peer(address)
             self._peers[address] = peer
         try:
-            peer.queue.put_nowait(data)
+            peer.queue.put_nowait((data, msg_type))
         except asyncio.QueueFull:
             _m_dropped.inc()
             log.warning("SimpleSender: queue full for %s; dropping", address)
 
-    def broadcast(self, addresses: Sequence[str], data: bytes) -> None:
+    def broadcast(
+        self, addresses: Sequence[str], data: bytes, msg_type: str = "other"
+    ) -> None:
         for addr in addresses:
-            self.send(addr, data)
+            self.send(addr, data, msg_type)
 
     def lucky_broadcast(
-        self, addresses: Sequence[str], data: bytes, nodes: int
+        self,
+        addresses: Sequence[str],
+        data: bytes,
+        nodes: int,
+        msg_type: str = "other",
     ) -> None:
         """Send to `nodes` random peers (reference simple_sender.rs:76-85)."""
-        self.broadcast(sample_peers(addresses, nodes), data)
+        self.broadcast(sample_peers(addresses, nodes), data, msg_type)
 
     def close(self) -> None:
         for peer in self._peers.values():

@@ -182,9 +182,17 @@ class Core:
         self._m_stale = metrics.counter("primary.stale_messages")
         self._m_late_votes = metrics.counter("primary.late_votes")
         # FIFO cache of verified header/cert digests (see VERIFIED_CACHE).
+        # Hits (re-deliveries that skipped crypto) and misses (fresh
+        # messages that paid for verification) are both exported: hits ÷
+        # (hits + misses) is the duplicate fraction of inbound traffic,
+        # and hits × claims-per-message is verification work the cache
+        # absorbed — the observability the PR 6 cache shipped without.
         self._verified_recent: Dict[bytes, None] = {}
         self._m_verify_cache_hits = metrics.counter(
             "primary.verify_cache_hits"
+        )
+        self._m_verify_cache_misses = metrics.counter(
+            "primary.verify_cache_misses"
         )
         self._m_vote_flushes = metrics.counter("primary.vote_flushes")
         # Fault-detection plane (read by the NARWHAL_HEALTH rules):
@@ -201,6 +209,25 @@ class Core:
             for n, a in self.primary_addresses.items()
             if n != name
         }
+        # Crypto-cost ledger, burst side: signature claims entering the
+        # batched verify PER MESSAGE KIND.  The backend's per-site
+        # instruments see the whole burst as "batch_burst"; these split
+        # it back into protocol terms (a header contributes 1 claim, a
+        # vote 1, a certificate 2f+2), which is what the bench's
+        # protocol-arithmetic cross-check reads.
+        self._m_burst_claims = {
+            kind: metrics.counter(f"crypto.burst_claims.{kind}")
+            for kind in ("header", "vote", "certificate")
+        }
+        # Wire-goodput ledger: empty vs payload-carrying own headers.
+        # "Empty certs per committed byte" (ROADMAP item 3's
+        # min_header_delay sub-question) needs the numerator counted at
+        # the source: an idle-round header and the votes/certificate it
+        # mints are pure control-plane overhead.
+        self._m_headers_empty = metrics.counter("primary.own_headers_empty")
+        self._m_headers_payload = metrics.counter(
+            "primary.own_headers_payload"
+        )
         self._mtrace = metrics.trace()
         self._rtrace = metrics.round_trace()
 
@@ -212,12 +239,17 @@ class Core:
         split-cast or re-sign the wire copy without re-implementing
         own-header processing."""
         return self.network.broadcast(
-            self.others_addresses, encode_primary_message(header)
+            self.others_addresses, encode_primary_message(header),
+            msg_type="header",
         )
 
     async def process_own_header(self, header: Header) -> None:
         self.current_header = header
         self.own_header_ids[header.round] = header.id
+        if header.payload:
+            self._m_headers_payload.inc()
+        else:
+            self._m_headers_empty.inc()
         self.votes_aggregator = VotesAggregator()
         handlers = self._broadcast_own_header(header)
         self._rtrace.mark(str(header.round), "header_broadcast")
@@ -311,7 +343,9 @@ class Core:
             )
         else:
             address = self.primary_addresses[header.author]
-            handler = self.network.send(address, encode_primary_message(vote))
+            handler = self.network.send(
+                address, encode_primary_message(vote), msg_type="vote"
+            )
             self.cancel_handlers.setdefault(header.round, []).append(handler)
 
     def _flush_pending(self) -> None:
@@ -325,7 +359,9 @@ class Core:
         self._m_vote_flushes.inc()
         staged, self._pending_votes = self._pending_votes, []
         for round, author, body in staged:
-            handler = self.network.send(self.primary_addresses[author], body)
+            handler = self.network.send(
+                self.primary_addresses[author], body, msg_type="vote"
+            )
             self.cancel_handlers.setdefault(round, []).append(handler)
 
     def _note_peer_vote(self, vote: Vote) -> None:
@@ -373,7 +409,8 @@ class Core:
             # its header's (possibly still buffered) record is logged.
             self.store.flush_deferred()
             handlers = self.network.broadcast(
-                self.others_addresses, encode_primary_message(certificate)
+                self.others_addresses, encode_primary_message(certificate),
+                msg_type="certificate",
             )
             self._rtrace.mark(str(certificate.round), "cert_broadcast")
             self.cancel_handlers.setdefault(certificate.round, []).extend(handlers)
@@ -630,19 +667,25 @@ class Core:
             seen = dedup_key is not None and dedup_key in self._verified_recent
             if seen:
                 self._m_verify_cache_hits.inc()
+            elif dedup_key is not None:
+                self._m_verify_cache_misses.inc()
             claims = (
                 item[1].signature_claims()
                 if not stale and not seen
                 and kind in ("header", "vote", "certificate")
                 else []
             )
+            if claims:
+                self._m_burst_claims[kind].inc(len(claims))
             spans.append((len(msgs), len(claims), stale, seen, dedup_key))
             for m, k, s in claims:
                 msgs.append(m)
                 keys.append(k)
                 sigs.append(s)
         mask = (
-            await crypto_backend.averify_batch_mask(msgs, keys, sigs)
+            await crypto_backend.averify_batch_mask(
+                msgs, keys, sigs, site="batch_burst"
+            )
             if msgs
             else []
         )

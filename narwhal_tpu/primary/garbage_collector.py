@@ -44,4 +44,6 @@ class GarbageCollector:
                 last_committed_round = round
                 self.consensus_round.value = round
                 for address in self.worker_addresses:
-                    self.sender.send(address, encode_cleanup(round))
+                    self.sender.send(
+                        address, encode_cleanup(round), msg_type="cleanup"
+                    )

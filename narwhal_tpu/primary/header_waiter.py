@@ -95,7 +95,9 @@ class HeaderWaiter:
                 log.warning("Header references unknown worker id %d", worker_id)
                 continue
             self.sender.send(
-                addrs.primary_to_worker, encode_synchronize(digests, header.author)
+                addrs.primary_to_worker,
+                encode_synchronize(digests, header.author),
+                msg_type="synchronize",
             )
         keys = [payload_key(d, w) for d, w in missing.items()]
         self._park(header, keys)
@@ -113,7 +115,9 @@ class HeaderWaiter:
         if to_request:
             address = self.committee.primary(header.author).primary_to_primary
             self.sender.send(
-                address, encode_certificates_request(to_request, self.name)
+                address,
+                encode_certificates_request(to_request, self.name),
+                msg_type="cert_request",
             )
         self._park(header, [bytes(d) for d in missing])
 
@@ -158,7 +162,10 @@ class HeaderWaiter:
                     for _, a in self.committee.others_primaries(self.name)
                 ]
                 message = encode_certificates_request(overdue, self.name)
-                self.sender.lucky_broadcast(addresses, message, self.sync_retry_nodes)
+                self.sender.lucky_broadcast(
+                    addresses, message, self.sync_retry_nodes,
+                    msg_type="cert_request",
+                )
                 for d in overdue:
                     r, _ = self.parent_requests[d]
                     self.parent_requests[d] = (r, now)

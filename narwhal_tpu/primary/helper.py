@@ -44,4 +44,6 @@ class Helper:
                     w = Writer()
                     w.u8(PM_CERTIFICATE)
                     w.raw(raw)
-                    self.sender.send(address, w.finish())
+                    self.sender.send(
+                        address, w.finish(), msg_type="certificate"
+                    )

@@ -47,7 +47,9 @@ class Header:
     async def new(cls, author, round, payload, parents, signature_service) -> "Header":
         header = cls(author=author, round=round, payload=payload, parents=set(parents))
         header.id = header.compute_digest()
-        header.signature = await signature_service.request_signature(header.id)
+        header.signature = await signature_service.request_signature(
+            header.id, site="header"
+        )
         return header
 
     def compute_digest(self) -> Digest:
@@ -77,7 +79,9 @@ class Header:
     def verify(self, committee: Committee) -> None:
         """Reference messages.rs:48-67."""
         self.verify_structure(committee)
-        if not verify(bytes(self.id), self.author, self.signature):
+        if not verify(
+            bytes(self.id), self.author, self.signature, site="header"
+        ):
             raise InvalidSignature(f"header {self.id!r}")
 
     def encode(self, w: Writer) -> None:
@@ -130,7 +134,9 @@ class Vote:
     @classmethod
     async def new(cls, header: Header, author: PublicKey, signature_service) -> "Vote":
         vote = cls(id=header.id, round=header.round, origin=header.author, author=author)
-        vote.signature = await signature_service.request_signature(vote.digest())
+        vote.signature = await signature_service.request_signature(
+            vote.digest(), site="vote"
+        )
         return vote
 
     def digest(self) -> Digest:
@@ -149,7 +155,9 @@ class Vote:
 
     def verify(self, committee: Committee) -> None:
         self.verify_structure(committee)
-        if not verify(bytes(self.digest()), self.author, self.signature):
+        if not verify(
+            bytes(self.digest()), self.author, self.signature, site="vote"
+        ):
             raise InvalidSignature(f"vote by {self.author!r}")
 
     def encode(self, w: Writer) -> None:
@@ -234,7 +242,10 @@ class Certificate:
         self.verify_structure(committee)
         self.header.verify(committee)
         if not verify_batch(
-            self.digest(), [n for n, _ in self.votes], [s for _, s in self.votes]
+            self.digest(),
+            [n for n, _ in self.votes],
+            [s for _, s in self.votes],
+            site="certificate",
         ):
             raise InvalidSignature(f"certificate {self.digest()!r}")
 
@@ -298,6 +309,15 @@ PM_HEADER = 0
 PM_VOTE = 1
 PM_CERTIFICATE = 2
 PM_CERTIFICATES_REQUEST = 3
+
+# Wire-type names for the goodput ledger (see narwhal_tpu/messages.py
+# frame_classifier): the primary↔primary plane's tag space.
+PRIMARY_FRAME_TYPES = {
+    PM_HEADER: "header",
+    PM_VOTE: "vote",
+    PM_CERTIFICATE: "certificate",
+    PM_CERTIFICATES_REQUEST: "cert_request",
+}
 
 
 def encode_primary_message(obj) -> bytes:

@@ -16,7 +16,11 @@ from typing import List
 from .. import metrics
 from ..config import Committee, Parameters
 from ..crypto import KeyPair, SignatureService
-from ..messages import decode_worker_primary_message
+from ..messages import (
+    WORKER_PRIMARY_FRAME_TYPES,
+    decode_worker_primary_message,
+    frame_classifier,
+)
 from ..network import Receiver, Writer
 from ..store import Store
 from .certificate_waiter import CertificateWaiter
@@ -24,7 +28,7 @@ from .core import AtomicRound, Core
 from .garbage_collector import GarbageCollector
 from .header_waiter import HeaderWaiter
 from .helper import Helper
-from .messages import decode_primary_message
+from .messages import PRIMARY_FRAME_TYPES, decode_primary_message
 from .payload_receiver import PayloadReceiver
 from .proposer import Proposer
 from .synchronizer import Synchronizer
@@ -147,12 +151,14 @@ class Primary:
             await Receiver.spawn(
                 addrs.primary_to_primary,
                 PrimaryReceiverHandler(tx_primaries, tx_helper),
+                classify=frame_classifier(PRIMARY_FRAME_TYPES),
             )
         )
         self.receivers.append(
             await Receiver.spawn(
                 addrs.worker_to_primary,
                 WorkerReceiverHandler(rx_our_digests, rx_others_digests),
+                classify=frame_classifier(WORKER_PRIMARY_FRAME_TYPES),
             )
         )
 

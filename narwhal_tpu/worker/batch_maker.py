@@ -207,7 +207,7 @@ class BatchMaker:
         # Reliable-broadcast to our counterpart workers at every other
         # authority; the ACK futures feed the quorum count.
         handlers = [
-            (stake, self.sender.send(addr, sealed.message))
+            (stake, self.sender.send(addr, sealed.message, msg_type="batch"))
             for stake, addr in self._peers
         ]
         item = (digest, sealed.message, handlers)

@@ -45,4 +45,4 @@ class Helper:
             for digest in digests:
                 serialized = self.store.read(bytes(digest))
                 if serialized is not None:
-                    self.sender.send(address, serialized)
+                    self.sender.send(address, serialized, msg_type="batch")

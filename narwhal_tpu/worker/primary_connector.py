@@ -19,4 +19,6 @@ class PrimaryConnector:
     async def run(self) -> None:
         while True:
             message = await self.in_queue.get()
-            self.sender.send(self.primary_address, message)
+            self.sender.send(
+                self.primary_address, message, msg_type="batch_digest"
+            )

@@ -89,7 +89,7 @@ class Synchronizer:
         except Exception:
             log.warning("Sync request for unknown target authority")
             return
-        self.sender.send(address, message)
+        self.sender.send(address, message, msg_type="batch_request")
 
     async def _await_arrival(self, digest: Digest) -> None:
         await self.store.notify_read(bytes(digest))
@@ -126,7 +126,10 @@ class Synchronizer:
                 for _, addrs in self.committee.others_workers(self.name, self.worker_id)
             ]
             message = encode_batch_request(overdue, self.name)
-            self.sender.lucky_broadcast(addresses, message, self.sync_retry_nodes)
+            self.sender.lucky_broadcast(
+                addresses, message, self.sync_retry_nodes,
+                msg_type="batch_request",
+            )
             for d in overdue:
                 r, _ = self.pending[d]
                 self.pending[d] = (r, now)

@@ -18,9 +18,12 @@ from .. import metrics, native
 from ..config import Committee, Parameters, WorkerId
 from ..crypto import PublicKey
 from ..messages import (
+    PRIMARY_WORKER_FRAME_TYPES,
     WORKER_BATCH,
+    WORKER_FRAME_TYPES,
     decode_primary_worker_message,
     decode_worker_message,
+    frame_classifier,
 )
 from ..network import Receiver, Writer
 from ..store import Store
@@ -164,11 +167,14 @@ class Worker:
             await Receiver.spawn(
                 addrs.worker_to_worker,
                 WorkerReceiverHandler(others_batches, helper_queue),
+                classify=frame_classifier(WORKER_FRAME_TYPES),
             )
         )
         self.receivers.append(
             await Receiver.spawn(
-                addrs.primary_to_worker, PrimaryReceiverHandler(sync_queue)
+                addrs.primary_to_worker,
+                PrimaryReceiverHandler(sync_queue),
+                classify=frame_classifier(PRIMARY_WORKER_FRAME_TYPES),
             )
         )
 

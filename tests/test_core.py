@@ -274,7 +274,7 @@ def test_burst_verifies_in_one_backend_call(run):
         calls = []
         real = cb.averify_batch_mask
 
-        async def counting(msgs, ks, ss):
+        async def counting(msgs, ks, ss, site="other"):
             calls.append(len(msgs))
             return await real(msgs, ks, ss)
 
@@ -648,7 +648,7 @@ def test_duplicate_delivery_skips_crypto_via_verified_cache(run):
         calls = []
         real = cb.averify_batch_mask
 
-        async def counting(msgs, ks, ss):
+        async def counting(msgs, ks, ss, site="other"):
             calls.append(len(msgs))
             return await real(msgs, ks, ss)
 
@@ -698,7 +698,7 @@ def test_tampered_redelivery_misses_cache_and_is_rejected(run):
         calls = []
         real = cb.averify_batch_mask
 
-        async def counting(msgs, ks, ss):
+        async def counting(msgs, ks, ss, site="other"):
             calls.append(len(msgs))
             return await real(msgs, ks, ss)
 
@@ -775,7 +775,7 @@ def test_late_vote_still_counts_toward_peer_votes(run):
         calls = []
         real = cb.averify_batch_mask
 
-        async def counting(msgs, ks, ss):
+        async def counting(msgs, ks, ss, site="other"):
             calls.append(len(msgs))
             return await real(msgs, ks, ss)
 

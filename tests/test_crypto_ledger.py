@@ -1,0 +1,173 @@
+"""Crypto-cost ledger tests: per-call-site sign/verify attribution
+(header / vote / certificate / batch_burst), batch-size histograms, the
+Core burst's per-kind claim counters against protocol arithmetic, and
+the VERIFIED_CACHE hit/miss export (re-delivered certificates must be
+crypto-free IN THE LEDGER, not just in principle)."""
+
+import asyncio
+
+from narwhal_tpu import metrics
+from narwhal_tpu.crypto import SignatureService, backend as cb
+from tests.common import (
+    committee,
+    keys,
+    make_certificate,
+    make_header,
+    make_vote,
+)
+from tests.test_core import make_core
+
+
+def run(coro, timeout=30):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+def cnt(name: str) -> float:
+    c = metrics.registry().counters.get(name)
+    return c.value if c is not None else 0
+
+
+def hist(name: str):
+    return metrics.registry().histograms.get(name)
+
+
+def test_sign_sites_via_signature_service():
+    """Header.new / Vote.new label their signing ops "header" / "vote"
+    through the SignatureService; direct KeyPair.sign stays "other"."""
+
+    async def go():
+        from narwhal_tpu.primary.messages import Header, Vote
+
+        me, author = keys()[0], keys()[1]
+        svc = SignatureService(me)
+        h_before = cnt("crypto.sign.ops.header")
+        v_before = cnt("crypto.sign.ops.vote")
+        header = await Header.new(me.name, 1, {}, set(), svc)
+        await Vote.new(header, me.name, svc)
+        assert cnt("crypto.sign.ops.header") - h_before == 1
+        assert cnt("crypto.sign.ops.vote") - v_before == 1
+        o_before = cnt("crypto.sign.ops.other")
+        author.sign(header.id)
+        assert cnt("crypto.sign.ops.other") - o_before == 1
+        # Wall time recorded per site.
+        h = hist("crypto.sign.seconds.header")
+        assert h is not None and h.count >= 1 and h.sum > 0
+        svc.close()
+
+    run(go())
+
+
+def test_verify_sites_inline_serial_path():
+    """The inline sanitization path attributes ops per message kind —
+    and a certificate's verify splits into its embedded header's
+    signature ("header") plus the 2f+1 vote batch ("certificate")."""
+    c = committee()
+    author = keys()[1]
+    header = make_header(author, c=c)
+    cert = make_certificate(header)
+    vote = make_vote(header, keys()[2])
+
+    before = {
+        s: cnt(f"crypto.verify.ops.{s}")
+        for s in ("header", "vote", "certificate")
+    }
+    cert_calls = (
+        hist("crypto.verify.batch_size.certificate").count
+        if hist("crypto.verify.batch_size.certificate")
+        else 0
+    )
+    header.verify(c)
+    vote.verify(c)
+    cert.verify(c)
+    # header.verify once directly + once inside cert.verify.
+    assert cnt("crypto.verify.ops.header") - before["header"] == 2
+    assert cnt("crypto.verify.ops.vote") - before["vote"] == 1
+    # 2f+1 = 3 vote signatures batched over the certificate digest.
+    assert cnt("crypto.verify.ops.certificate") - before["certificate"] == 3
+    h = hist("crypto.verify.batch_size.certificate")
+    assert h.count == cert_calls + 1
+    # The one new observation was a 3-signature batch (bucket mean).
+    assert h.sum >= 3
+
+
+def test_core_burst_claims_match_protocol_arithmetic():
+    """One certificate through the Core's burst path: quorum+1 claims
+    (2f+1 votes + the embedded header's signature) counted under
+    crypto.burst_claims.certificate and verified at site batch_burst."""
+
+    async def go():
+        c = committee()
+        me, author = keys()[0], keys()[1]
+        core, store, qs = make_core(c, me)
+        cert = make_certificate(make_header(author, c=c))
+        quorum = c.quorum_threshold()
+
+        before_claims = cnt("crypto.burst_claims.certificate")
+        before_ops = cnt("crypto.verify.ops.batch_burst")
+        await core._handle_primaries_burst([("certificate", cert)])
+        assert (
+            cnt("crypto.burst_claims.certificate") - before_claims
+            == quorum + 1
+        )
+        assert (
+            cnt("crypto.verify.ops.batch_burst") - before_ops == quorum + 1
+        )
+        core.network.close()
+
+    asyncio.run(asyncio.wait_for(go(), 30))
+
+
+def test_verified_cache_hits_export_and_zero_new_verify_ops():
+    """The PR 6 verified-digest cache, now observable: a re-delivered
+    certificate produces a cache HIT and ZERO new verify ops in the
+    crypto ledger (first delivery is a counted MISS that pays quorum+1
+    ops)."""
+
+    async def go():
+        c = committee()
+        me, author = keys()[0], keys()[1]
+        core, store, qs = make_core(c, me)
+        cert = make_certificate(make_header(author, c=c))
+
+        hits0 = cnt("primary.verify_cache_hits")
+        miss0 = cnt("primary.verify_cache_misses")
+        ops0 = cnt("crypto.verify.ops.batch_burst")
+        await core._handle_primaries_burst([("certificate", cert)])
+        assert cnt("primary.verify_cache_misses") - miss0 == 1
+        assert cnt("primary.verify_cache_hits") - hits0 == 0
+        ops_after_first = cnt("crypto.verify.ops.batch_burst")
+        assert ops_after_first - ops0 == c.quorum_threshold() + 1
+
+        # Re-delivery: a hit, and the verify-op counter does not move.
+        await core._handle_primaries_burst([("certificate", cert)])
+        assert cnt("primary.verify_cache_hits") - hits0 == 1
+        assert cnt("primary.verify_cache_misses") - miss0 == 1
+        assert cnt("crypto.verify.ops.batch_burst") == ops_after_first
+        core.network.close()
+
+    run_coro(go())
+
+
+def run_coro(coro):
+    return asyncio.run(asyncio.wait_for(coro, 30))
+
+
+def test_averify_site_default_does_not_pollute_burst_site():
+    async def go():
+        me = keys()[0]
+        d = metrics_digest(b"m" * 32)
+        sig = me.sign(d)
+        before = cnt("crypto.verify.ops.batch_burst")
+        other = cnt("crypto.verify.ops.other")
+        ok = await cb.averify_batch_mask([bytes(d)], [me.name], [sig])
+        assert ok == [True]
+        assert cnt("crypto.verify.ops.batch_burst") == before
+        assert cnt("crypto.verify.ops.other") - other == 1
+
+    run_coro(go())
+
+
+def metrics_digest(data: bytes):
+    from narwhal_tpu.crypto import digest32
+
+    return digest32(data)

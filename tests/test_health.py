@@ -538,3 +538,39 @@ def test_build_timeline_events_from_quiesce_healthz_only():
         ("w-0", "FIRING")
     ]
     assert timeline["healthz"]["w-0"]["firing"] == ["ceiling"]
+
+
+# -- stale_replay default vs post-heal catch-up (ISSUE 7 satellite) -----------
+
+
+def test_stale_replay_default_rides_out_heal_burst_but_fires_on_flood():
+    """The DEFAULT stale-rate threshold must sit ABOVE a healed node's
+    catch-up burst: the wan_partition_heal scenario's healed node
+    replays its backlog at a measured 2.4-2.9 stale messages/s, and the
+    old 2/s default FIRED transiently on exactly that (ROADMAP item 4's
+    named follow-up).  The replay-flood attack the rule exists for
+    (byz_replay_stale: 10/s per peer) must still fire — with NO env
+    overrides, since this test pins the shipped default."""
+    reg = Registry()
+    stale = reg.counter("primary.stale_messages")
+    mon = HealthMonitor(reg, rules=default_rules({}), interval_s=1.0)
+    t = 5000.0
+    # Post-heal catch-up: 2.9 stale/s sustained for 15 s — the worst
+    # burst observed on the healed node — must never fire.
+    acc = 0.0
+    for i in range(15):
+        acc += 2.9
+        while stale.value < int(acc):
+            stale.inc()
+        firing = mon.evaluate(t + i)
+        assert "stale_replay" not in {f["rule"] for f in firing}, (
+            f"heal-burst rate fired at tick {i}: {firing}"
+        )
+    # An actual replay flood (10/s, the byz_replay_stale magnitude per
+    # peer) fires within a few intervals.
+    fired = False
+    for i in range(15, 25):
+        stale.inc(10)
+        firing = mon.evaluate(t + i)
+        fired = fired or "stale_replay" in {f["rule"] for f in firing}
+    assert fired

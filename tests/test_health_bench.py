@@ -123,3 +123,36 @@ def test_clean_local_bench_has_timeline_and_no_firing_rules(tmp_path):
     for name, verdict in healthz.items():
         assert verdict["status"] == 200, (name, verdict)
         assert verdict["firing"] == [], (name, verdict)
+
+    # -- wire-goodput ledger (ISSUE 7 acceptance) ----------------------------
+    wire = result.wire
+    totals = wire["totals"]
+    # (a) Per-type wire bytes (incl. retransmits) sum to the raw sender
+    # byte counters within 2%: every sent byte carries a type label.
+    assert totals["sender_coverage"] is not None
+    assert abs(totals["sender_coverage"] - 1.0) <= 0.02, totals
+    # The protocol's frame types all flowed on a busy committee.
+    for t in ("batch", "batch_digest", "header", "vote", "certificate"):
+        assert wire["out"].get(t, {}).get("bytes", 0) > 0, (t, wire["out"])
+    # Sender vs receiver totals reconcile per type.  Loopback TCP loses
+    # nothing mid-run, but teardown kills nodes with frames in flight
+    # and the final snapshot is written at SIGTERM — allow the tail.
+    for t, ratio in wire["recv_vs_sent"].items():
+        assert 0.85 <= ratio <= 1.01, (t, ratio, wire)
+    # Goodput ratio is reported and sane: committed payload can never
+    # exceed what went on the wire.
+    assert 0 < wire["goodput_ratio"] < 1, wire
+    assert 0 < wire["cert_sig_bytes_fraction"] < 1, wire
+
+    # -- crypto-cost ledger (ISSUE 7 acceptance) -----------------------------
+    crypto = result.crypto
+    # The committee verifies through the burst seam; signing splits into
+    # header/vote sites.
+    assert crypto["verify"]["batch_burst"]["ops"] > 0
+    assert crypto["sign"]["header"]["ops"] > 0
+    assert crypto["sign"]["vote"]["ops"] > 0
+    # (b) Protocol-arithmetic cross-check within 5%: one verified claim
+    # per peer vote, quorum+1 claims per wire certificate.
+    check = crypto["protocol_check"]
+    assert abs(check["votes"]["ratio"] - 1.0) <= 0.05, check
+    assert abs(check["certificates"]["ratio"] - 1.0) <= 0.05, check

@@ -1,0 +1,233 @@
+"""benchmark/trajectory.py tests: loaders per artifact shape, graceful
+skip of missing/malformed/rc!=0/zero-valued files, the attr. namespace
+split for fixed-rate artifacts, regression detection with pinned
+tolerances, waivers, and the gate's exit codes — including that the
+REPO'S OWN committed artifacts pass the gate while the known r05
+regression is flagged (waived)."""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmark import trajectory  # noqa: E402
+
+
+def write(path, obj):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        if isinstance(obj, str):
+            f.write(obj)
+        else:
+            json.dump(obj, f)
+
+
+def driver_bench(value, rc=0, metric="end_to_end_tps_local_4n", **extra):
+    return {
+        "n": 1,
+        "cmd": "python bench.py",
+        "rc": rc,
+        "parsed": {"metric": metric, "value": value, "unit": "tx/s", **extra},
+    }
+
+
+def gate_config(tmp_path, tolerances=None, waivers=None):
+    p = str(tmp_path / "gate.json")
+    write(p, {
+        "tolerances": tolerances
+        if tolerances is not None
+        else {"end_to_end_tps": 0.15},
+        "waivers": waivers or [],
+    })
+    return p
+
+
+def test_collect_revisions_and_graceful_skips(tmp_path, capsys):
+    root = str(tmp_path)
+    write(f"{root}/BENCH_r01.json", driver_bench(10_000))
+    write(f"{root}/BENCH_r02.json", driver_bench(12_000))
+    # rc != 0: warn and skip, never crash the gate.
+    write(f"{root}/BENCH_r03.json", driver_bench(9_000, rc=1))
+    # Failed measurement published zeros with a clean rc (the real
+    # r03/r04 shape): unusable, skipped.
+    write(f"{root}/BENCH_r04.json", driver_bench(0.0))
+    # Malformed JSON: skip.
+    write(f"{root}/BENCH_r05.json", "{not json")
+    # Unrecognized artifact shape: skip with reason.
+    write(f"{root}/artifacts/foo_r02.json", {"rows": [1, 2, 3]})
+    # before/pre arms are skipped by design.
+    write(
+        f"{root}/artifacts/thing_r02_before.json",
+        {"end_to_end_tps": 1.0},
+    )
+    revisions, skipped = trajectory.collect(root)
+    assert sorted(revisions) == ["r01", "r02"]
+    assert revisions["r01"]["metrics"]["end_to_end_tps"] == 10_000
+    reasons = {s["file"]: s["reason"] for s in skipped}
+    assert "rc=1" in reasons["BENCH_r03.json"]
+    assert "no usable measurement" in reasons["BENCH_r04.json"]
+    assert "malformed" in reasons["BENCH_r05.json"]
+    assert "unrecognized" in reasons[os.path.join("artifacts", "foo_r02.json")]
+    assert "skipped by design" in reasons[
+        os.path.join("artifacts", "thing_r02_before.json")
+    ]
+
+
+def test_artifacts_feed_attr_namespace_not_the_gate(tmp_path):
+    """Fixed-rate artifacts/ captures are cross-revision comparable with
+    each other but not with the saturation-probe driver numbers — they
+    land under attr.* which the gate config never names."""
+    root = str(tmp_path)
+    write(f"{root}/BENCH_r01.json", driver_bench(10_000))
+    write(
+        f"{root}/artifacts/breakdown_r01.json",
+        {
+            "consensus_tps": 2_000,
+            "stages_ms": {"seal_to_commit": 2_100.0},
+        },
+    )
+    revisions, _ = trajectory.collect(root)
+    m = revisions["r01"]["metrics"]
+    assert m["end_to_end_tps"] == 10_000
+    assert m["attr.consensus_tps"] == 2_000
+    assert m["attr.stage.seal_to_commit"] == 2_100.0
+    assert "consensus_tps" not in m
+
+
+def test_runs_artifact_takes_median(tmp_path):
+    root = str(tmp_path)
+    write(
+        f"{root}/artifacts/ab_r07.json",
+        {
+            "runs": [
+                {"end_to_end_tps": 100.0},
+                {"end_to_end_tps": 300.0},
+                {"end_to_end_tps": 200.0},
+            ]
+        },
+    )
+    revisions, _ = trajectory.collect(root)
+    assert revisions["r07"]["metrics"]["attr.end_to_end_tps"] == 200.0
+
+
+def test_regression_against_best_prior_revision():
+    series = {
+        "end_to_end_tps": [
+            ("r01", 10_000.0),
+            ("r02", 12_000.0),
+            ("r03", 11_000.0),  # -8.3% vs r02: inside 15%
+            ("r04", 9_000.0),  # -25% vs r02: regression
+        ],
+        "end_to_end_latency_ms": [
+            ("r01", 800.0),
+            ("r02", 2_000.0),  # +150% vs r01: regression (lower-better)
+        ],
+    }
+    config = {
+        "tolerances": {
+            "end_to_end_tps": 0.15,
+            "end_to_end_latency_ms": 0.5,
+        },
+        "waivers": [],
+    }
+    regs = trajectory.find_regressions(series, config)
+    assert [(r["metric"], r["revision"]) for r in regs] == [
+        ("end_to_end_latency_ms", "r02"),
+        ("end_to_end_tps", "r04"),
+    ]
+    tps = next(r for r in regs if r["metric"] == "end_to_end_tps")
+    assert tps["baseline_revision"] == "r02"
+    assert tps["change_pct"] == -25.0
+    assert not tps["waived"]
+
+
+def test_waiver_keeps_regression_in_report_but_gate_green(tmp_path, capsys):
+    root = str(tmp_path)
+    write(f"{root}/BENCH_r01.json", driver_bench(10_000))
+    write(f"{root}/BENCH_r02.json", driver_bench(5_000))
+    cfg = gate_config(
+        tmp_path,
+        waivers=[
+            {
+                "metric": "end_to_end_tps",
+                "revision": "r02",
+                "reason": "known, owned elsewhere",
+            }
+        ],
+    )
+    report = str(tmp_path / "report.json")
+    rc = trajectory.main(
+        ["--root", root, "--gate-config", cfg, "--report", report]
+    )
+    assert rc == 0
+    rep = json.load(open(report))
+    assert len(rep["regressions"]) == 1
+    assert rep["regressions"][0]["waived"] is True
+    assert rep["gate"]["unwaived_regressions"] == 0
+
+
+def test_gate_fails_nonzero_on_injected_synthetic_regression(tmp_path):
+    root = str(tmp_path)
+    write(f"{root}/BENCH_r01.json", driver_bench(10_000))
+    write(f"{root}/BENCH_r02.json", driver_bench(4_000))  # -60%
+    cfg = gate_config(tmp_path)
+    rc = trajectory.main(["--root", root, "--gate-config", cfg, "--quiet"])
+    assert rc == 2
+    # --no-gate reports but never fails.
+    assert (
+        trajectory.main(
+            ["--root", root, "--gate-config", cfg, "--no-gate", "--quiet"]
+        )
+        == 0
+    )
+
+
+def test_missing_gate_config_disables_gating_loudly(tmp_path, capsys):
+    root = str(tmp_path)
+    write(f"{root}/BENCH_r01.json", driver_bench(10_000))
+    write(f"{root}/BENCH_r02.json", driver_bench(1_000))
+    rc = trajectory.main(
+        [
+            "--root", root,
+            "--gate-config", str(tmp_path / "nope.json"),
+            "--quiet",
+        ]
+    )
+    assert rc == 0
+    assert "gating disabled" in capsys.readouterr().err
+
+
+def test_empty_root_reports_nothing_and_passes(tmp_path):
+    rc = trajectory.main(
+        [
+            "--root", str(tmp_path),
+            "--gate-config", str(tmp_path / "nope.json"),
+            "--quiet",
+        ]
+    )
+    assert rc == 0
+
+
+def test_repo_committed_artifacts_pass_with_r05_waived():
+    """The acceptance pin: over THIS repo's committed BENCH_r*.json the
+    gate is green, all five driver artifacts are covered (r03/r04 as
+    explicit skips — they published zeros for failed runs), and the r05
+    e2e regression is flagged but waived by name."""
+    revisions, skipped = trajectory.collect(trajectory.REPO, quiet=True)
+    assert {"r01", "r02", "r05"} <= set(revisions)
+    skipped_files = {s["file"] for s in skipped}
+    assert {"BENCH_r03.json", "BENCH_r04.json"} <= skipped_files
+    series = trajectory.build_series(revisions)
+    config = trajectory.load_gate_config(trajectory.DEFAULT_GATE_CONFIG)
+    regs = trajectory.find_regressions(series, config)
+    r05 = [r for r in regs if r["revision"] == "r05"]
+    assert r05, "the r05 e2e regression must be detected"
+    assert all(r["waived"] for r in regs), (
+        "committed history must carry no unwaived regression: "
+        + repr([r for r in regs if not r["waived"]])
+    )
+    tps = next(r for r in r05 if r["metric"] == "end_to_end_tps")
+    assert tps["baseline_revision"] == "r02"
