@@ -11,7 +11,7 @@
 PYTHON ?= python
 
 .PHONY: check native lint test test-ci metrics-smoke fault-smoke \
-	trajectory bench clean
+	fault-fuzz-smoke trajectory bench clean
 
 check: native lint test
 
@@ -58,6 +58,19 @@ fault-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) benchmark/fault_bench.py \
 		--scenario benchmark/scenarios/byz_wrong_key.json \
 		--scenario benchmark/scenarios/crash_restart.json \
+		--artifact '.ci-artifacts/fault-{name}.json'
+
+# Worker-plane + fuzz smoke: one worker-plane Byzantine scenario, one
+# multi-fault composition, and a bounded fuzz run (three fixed seeds
+# through narwhal_tpu/faults/fuzz.py — each generated scenario is dumped
+# as a replayable .spec.json beside its artifact), all three-verdict
+# gated with clean-control arms.  Artifacts in .ci-artifacts/.
+fault-fuzz-smoke:
+	mkdir -p .ci-artifacts
+	JAX_PLATFORMS=cpu $(PYTHON) benchmark/fault_bench.py \
+		--scenario benchmark/scenarios/byz_sync_flood.json \
+		--scenario benchmark/scenarios/compose_equivocate_wan_lossy.json \
+		--fuzz-seed 101 --fuzz-seed 202 --fuzz-seed 303 \
 		--artifact '.ci-artifacts/fault-{name}.json'
 
 # Cross-revision perf-trajectory gate (benchmark/trajectory.py): reads

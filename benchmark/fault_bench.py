@@ -6,10 +6,11 @@ and emit one artifact with three machine-checked verdicts.
 
 Per scenario (narwhal_tpu/faults/spec.py) the runner launches a
 local_bench-style committee with the scenario's fault planes wired in
-(Byzantine plans via ``--fault-plan``/NARWHAL_FAULT_PLAN, WAN shaping via
-NARWHAL_FAULT_NETEM, crash/restart orchestrated from here with SIGKILL +
-respawn over the same store), scrapes every node throughout, and then
-judges:
+(Byzantine plans via ``--fault-plan``/NARWHAL_FAULT_PLAN — handed to the
+authority's primary AND its workers, each role acting on its own plane's
+behaviors; WAN shaping via NARWHAL_FAULT_NETEM; crash/restart
+orchestrated from here with SIGKILL + respawn over the same store),
+scrapes every node throughout, and then judges:
 
 - **safety** — every honest node's consensus audit segments replayed
   through the frozen golden oracle (consensus/replay.py): byte-identical
@@ -22,10 +23,16 @@ judges:
   ``events`` track, and (unless ``--skip-control``) a control arm with
   all fault planes stripped fires NOTHING.
 
+``--fuzz-seed N`` (repeatable) generates a scenario from
+narwhal_tpu/faults/fuzz.py instead of a file, dumping it as a normal
+``<name>.spec.json`` beside the artifact BEFORE running it, so any fuzz
+catch replays byte-for-byte via ``--scenario`` with no fuzzer in the
+loop.
+
 The scenario clock starts when the committee is launched (netem's
 ``start_ts`` anchor): crash/partition offsets must leave a few seconds of
 boot slack.  Exit code is non-zero if any verdict fails — the CI
-fault-smoke gate.
+fault-smoke / fault-fuzz-smoke gates.
 """
 
 from __future__ import annotations
@@ -248,6 +255,8 @@ def run_scenario(
             "behaviors": b.behaviors,
             "seed": scenario.seed ^ (b.node + 1),
             "replay_interval_ms": b.replay_interval_ms,
+            "flood_interval_ms": b.flood_interval_ms,
+            "garbage_bytes": b.garbage_bytes,
         }
         if b.targets:
             plan["withhold_targets"] = [
@@ -335,16 +344,22 @@ def run_scenario(
             )
             if inc == 0:
                 scrape_targets.append((label, "127.0.0.1", mport))
+            wcmd = [
+                sys.executable, "-m", "narwhal_tpu.node", "run",
+                "--keys", f"{workdir}/node-{i}.json",
+                "--committee", f"{workdir}/committee.json",
+                "--parameters", f"{workdir}/parameters.json",
+                "--store", f"{storedir}/db-worker-{i}-{wid}",
+                "--metrics-port", str(mport),
+            ]
+            if i in plan_paths:
+                # One plan per authority, both roles: the worker acts on
+                # the plan's worker-plane behaviors, the primary on the
+                # primary-plane ones (each ignores the other set).
+                wcmd += ["--fault-plan", plan_paths[i]]
+            wcmd += ["worker", "--id", str(wid)]
             p = spawn(
-                [
-                    sys.executable, "-m", "narwhal_tpu.node", "run",
-                    "--keys", f"{workdir}/node-{i}.json",
-                    "--committee", f"{workdir}/committee.json",
-                    "--parameters", f"{workdir}/parameters.json",
-                    "--store", f"{storedir}/db-worker-{i}-{wid}",
-                    "--metrics-port", str(mport),
-                    "worker", "--id", str(wid),
-                ],
+                wcmd,
                 log_path,
                 node_env(label, {}),
             )
@@ -590,8 +605,14 @@ def run(
 
 def main() -> int:
     parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
-    parser.add_argument("--scenario", required=True, action="append",
+    parser.add_argument("--scenario", action="append", default=[],
                         help="scenario JSON path (repeatable)")
+    parser.add_argument("--fuzz-seed", type=int, action="append", default=[],
+                        help="generate a scenario from this seed "
+                        "(narwhal_tpu/faults/fuzz.py; repeatable).  The "
+                        "generated spec is dumped as <name>.spec.json next "
+                        "to the artifact (or into --workdir), so any fuzz "
+                        "catch replays byte-for-byte via --scenario")
     parser.add_argument("--artifact", default=None,
                         help="write the artifact JSON here (one scenario) "
                         "or use it as a '{name}' template (several)")
@@ -603,18 +624,56 @@ def main() -> int:
     parser.add_argument("--quiet", action="store_true")
     args = parser.parse_args()
 
-    if args.artifact and len(args.scenario) > 1 and (
-        "{name}" not in args.artifact
-    ):
+    if not args.scenario and not args.fuzz_seed:
+        parser.error("need at least one --scenario or --fuzz-seed")
+    n_runs = len(args.scenario) + len(args.fuzz_seed)
+    if args.artifact and n_runs > 1 and "{name}" not in args.artifact:
         parser.error(
-            "--artifact must contain '{name}' when several --scenario "
-            "flags are given (a fixed path would silently overwrite "
-            "each scenario's artifact with the next)"
+            "--artifact must contain '{name}' when several --scenario/"
+            "--fuzz-seed flags are given (a fixed path would silently "
+            "overwrite each scenario's artifact with the next)"
+        )
+
+    # (scenario, generated-spec object or None) in CLI order.
+    scenarios = [(load_scenario(path), None) for path in args.scenario]
+    if args.fuzz_seed:
+        from narwhal_tpu.faults.fuzz import generate
+        from narwhal_tpu.faults.spec import parse_scenario
+
+        for seed in args.fuzz_seed:
+            obj = generate(seed)
+            scenarios.append((parse_scenario(obj), obj))
+
+    # The '{name}' template only prevents collisions between DISTINCT
+    # names — a repeated --fuzz-seed, or a --scenario replay of a dumped
+    # fuzz spec alongside its generating seed, resolves to the same name
+    # and would silently overwrite the first run's artifact and spec.
+    names = [s.name for s, _ in scenarios]
+    dupes = sorted({n for n in names if names.count(n) > 1})
+    if dupes:
+        parser.error(
+            f"scenario name(s) {dupes} appear more than once across "
+            "--scenario/--fuzz-seed; later runs would overwrite the "
+            "earlier artifacts"
         )
 
     failures = 0
-    for path in args.scenario:
-        scenario = load_scenario(path)
+    for scenario, fuzz_spec in scenarios:
+        if fuzz_spec is not None:
+            # The replayable spec is written BEFORE the run: a fuzz draw
+            # that crashes the runner must still be reproducible.
+            spec_dir = (
+                os.path.dirname(args.artifact) if args.artifact
+                else args.workdir
+            )
+            os.makedirs(spec_dir or ".", exist_ok=True)
+            spec_path = os.path.join(
+                spec_dir, f"{scenario.name}.spec.json"
+            )
+            with open(spec_path, "w") as f:
+                json.dump(fuzz_spec, f, indent=1)
+            if not args.quiet:
+                print(f"fuzz spec -> {spec_path}", file=sys.stderr)
         artifact = run(
             scenario,
             args.workdir,

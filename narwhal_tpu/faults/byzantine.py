@@ -66,19 +66,38 @@ class ByzantinePlan:
         seed: int = 0,
         withhold_targets: Optional[Set[PublicKey]] = None,
         replay_interval_ms: int = 250,
+        flood_interval_ms: int = 200,
+        garbage_bytes: int = 2_200_000,
     ) -> None:
         unknown = set(behaviors) - set(BYZANTINE_BEHAVIORS)
         if unknown:
             raise SpecError(f"unknown byzantine behavior(s): {sorted(unknown)}")
+        if {"withhold_batches", "garbage_batches"} <= set(behaviors):
+            raise SpecError(
+                "withhold_batches and garbage_batches conflict "
+                "(both decide what the worker Helper serves)"
+            )
         self.behaviors = set(behaviors)
         self.rng = random.Random(seed)
         # None = withhold from every other author.
         self.withhold_targets = withhold_targets
         self.replay_interval_ms = replay_interval_ms
+        self.flood_interval_ms = flood_interval_ms
+        self.garbage_bytes = garbage_bytes
         self.twins: Dict[Round, Header] = {}
         # Deterministic rogue identity for wrong_key: valid ed25519
         # signatures from a key that is simply not the author's.
         self.rogue = KeyPair.generate(self.rng.randbytes(32))
+
+    def primary_behaviors(self) -> Set[str]:
+        from .spec import PRIMARY_BEHAVIORS
+
+        return self.behaviors & set(PRIMARY_BEHAVIORS)
+
+    def worker_behaviors(self) -> Set[str]:
+        from .spec import WORKER_BEHAVIORS
+
+        return self.behaviors & set(WORKER_BEHAVIORS)
 
     @classmethod
     def from_json(cls, obj: dict) -> "ByzantinePlan":
@@ -91,6 +110,8 @@ class ByzantinePlan:
             seed=int(obj.get("seed", 0)),
             withhold_targets=resolved,
             replay_interval_ms=int(obj.get("replay_interval_ms", 250)),
+            flood_interval_ms=int(obj.get("flood_interval_ms", 200)),
+            garbage_bytes=int(obj.get("garbage_bytes", 2_200_000)),
         )
 
     @classmethod
@@ -110,14 +131,14 @@ class ByzantinePlan:
         return addrs[:keep], addrs[keep:]
 
 
-def _require_unit_stake(committee) -> None:
-    """The equivocate split sizes both the twin's parent set and the
-    real-header peer share by COUNT against the stake-denominated
-    ``quorum_threshold()`` — only valid when every stake is 1 (count ==
-    stake).  On a weighted committee the twin could fall below parent
-    quorum (never proven at any peer) or the real share could miss 2f+1
-    (never certified), silently voiding the scenario — refuse loudly
-    instead."""
+def _require_unit_stake(committee, behavior: str = "equivocate") -> None:
+    """Behaviors that split a peer set by COUNT against the
+    stake-denominated ``quorum_threshold()`` (equivocate's twin/real
+    share, the worker plane's withhold/garbage under-share) are only
+    valid when every stake is 1 (count == stake).  On a weighted
+    committee the split could fall below quorum (never certified /
+    never proven at any peer), silently voiding the scenario — refuse
+    loudly instead, naming the behavior that needs the property."""
     stakes = {
         str(n): a.stake
         for n, a in committee.authorities.items()
@@ -125,7 +146,7 @@ def _require_unit_stake(committee) -> None:
     }
     if stakes:
         raise SpecError(
-            "the 'equivocate' behavior requires a unit-stake committee "
+            f"the {behavior!r} behavior requires a unit-stake committee "
             f"(count == stake); found weighted authorities: {stakes}"
         )
 

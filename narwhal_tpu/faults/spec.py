@@ -4,8 +4,11 @@ A scenario is one JSON file naming a committee shape, a load profile, and
 up to three fault planes:
 
 - ``byzantine``: per-node behavior lists drawn from
-  :data:`BYZANTINE_BEHAVIORS` (executed in-process by
-  ``narwhal_tpu.faults.byzantine.ByzantineCore``/``ByzantineProposer``);
+  :data:`BYZANTINE_BEHAVIORS` — primary-plane behaviors executed
+  in-process by ``narwhal_tpu.faults.byzantine.ByzantineCore``/
+  ``ByzantineProposer``, worker-plane behaviors (batch withholding,
+  garbage serving, sync flooding) by
+  ``narwhal_tpu.faults.byzantine_worker``;
 - ``crash``: kill an authority's processes mid-run (SIGKILL — the point is
   to exercise the torn-file/far-frontier restore paths) and restart them
   from their on-disk store + consensus checkpoint while the committee is
@@ -13,6 +16,12 @@ up to three fault planes:
 - ``wan``: latency/jitter/loss defaults, per-directed-pair overrides, and
   time-windowed partitions, compiled by the runner into the per-node
   config ``narwhal_tpu.faults.netem`` loads inside each process.
+
+Fault planes COMPOSE: one scenario may put different planes on distinct
+nodes (a Byzantine worker on one authority while another crashes, an
+equivocating primary under committee-wide WAN loss, ...) — the parser
+enforces the BFT bound over the UNION of byzantine + crashed +
+partitioned nodes so a composition can never silently cost quorum.
 
 ``expect.rules`` names the HealthMonitor rules the scenario must light up
 (the detection verdict); the safety and liveness verdicts are computed
@@ -32,12 +41,24 @@ import os
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
-BYZANTINE_BEHAVIORS = (
+PRIMARY_BEHAVIORS = (
     "equivocate",       # two conflicting headers per round, disjoint peer sets
     "wrong_key",        # headers broadcast with a rogue-key signature
     "withhold_votes",   # never vote for targeted authors' headers
     "replay_stale",     # re-broadcast own old certificates forever
 )
+
+# Worker-plane behaviors (narwhal_tpu.faults.byzantine_worker): the
+# payload-availability attacks.  A behavior list may mix primary and
+# worker behaviors — the runner hands the same plan to the authority's
+# primary AND its workers and each plane acts only on its own set.
+WORKER_BEHAVIORS = (
+    "withhold_batches",  # certify via the ACK quorum, never serve the bytes
+    "garbage_batches",   # serve corrupted/oversized junk to sync requests
+    "sync_flood",        # repeated max-size BatchRequests (amplification)
+)
+
+BYZANTINE_BEHAVIORS = PRIMARY_BEHAVIORS + WORKER_BEHAVIORS
 
 
 class SpecError(ValueError):
@@ -52,6 +73,13 @@ class ByzantineSpec:
     # authority (resolved to base64 public keys by the runner).
     targets: List[int] = field(default_factory=list)
     replay_interval_ms: int = 250
+    # sync_flood: cadence of the flood requests.
+    flood_interval_ms: int = 200
+    # garbage_batches: size of the junk batch served to sync requests.
+    # The default sits well above the worker's default accepted-batch
+    # ceiling (2 x batch_size + 64 KiB; see worker.max_batch_bytes) so
+    # the junk is REJECTED and counted, not hashed and persisted.
+    garbage_bytes: int = 2_200_000
 
 
 @dataclass
@@ -175,6 +203,14 @@ def parse_scenario(
     for b in obj.get("byzantine", []):
         behaviors = list(b.get("behaviors", []))
         _require(behaviors, "byzantine entry needs behaviors")
+        node_dup = int(b["node"])
+        _require(
+            node_dup not in {x.node for x in byz},
+            f"duplicate byzantine entry for node {node_dup} (one entry "
+            "per node — the runner writes ONE plan file per authority, "
+            "so a second entry would silently replace the first; list "
+            "all of a node's behaviors in one entry)",
+        )
         for beh in behaviors:
             _require(
                 beh in BYZANTINE_BEHAVIORS,
@@ -192,7 +228,17 @@ def parse_scenario(
                 behaviors=behaviors,
                 targets=targets,
                 replay_interval_ms=int(b.get("replay_interval_ms", 250)),
+                flood_interval_ms=int(b.get("flood_interval_ms", 200)),
+                garbage_bytes=int(b.get("garbage_bytes", 2_200_000)),
             )
+        )
+    # One node's Helper can refuse sync requests or poison them, not both
+    # — the two behaviors own the same serve decision.
+    for b in byz:
+        _require(
+            not {"withhold_batches", "garbage_batches"} <= set(b.behaviors),
+            f"node {b.node}: withhold_batches and garbage_batches "
+            "conflict (both decide what the Helper serves)",
         )
     # Faults must stay within BFT tolerance or the verdicts are vacuous.
     f_tol = (nodes - 1) // 3

@@ -718,6 +718,12 @@ def default_rules(env: Optional[Mapping[str, str]] = None) -> List[HealthRule]:
     # comfortably under the attack floor.
     stale_rate_max = f("NARWHAL_HEALTH_STALE_RATE", 6)
     stale_window = f("NARWHAL_HEALTH_STALE_WINDOW_S", 5)
+    # Worker plane: how long a requested-but-unserved batch may age
+    # before it reads as withholding.  The default sits above the stock
+    # sync_retry_delay (5 s) so an ordinary first-retry window stays
+    # silent; withholding scenarios lower it alongside a raised retry
+    # delay to make the starvation unambiguous.
+    sync_age_max = f("NARWHAL_HEALTH_SYNC_AGE_S", 8)
 
     def commit_lag(ctx: HealthContext) -> Dict[str, dict]:
         v = ctx.gauge("consensus.commit_lag_rounds")
@@ -839,6 +845,46 @@ def default_rules(env: Optional[Mapping[str, str]] = None) -> List[HealthRule]:
             }
         return {}
 
+    # -- worker-plane availability detections (fault suite, ISSUE 8).
+    # The first reads the synchronizer's oldest-unserved age (a live
+    # gauge: it clears when the batch finally lands); the other two latch
+    # on monotone counters of events an honest committee never produces,
+    # like the equivocation/invalid_signature pair.
+
+    def batch_withholding(ctx: HealthContext) -> Dict[str, dict]:
+        # A certificate is a proof of batch availability — a requested
+        # digest that stays unserved past the threshold means some quorum
+        # ACKer is not serving the bytes it vouched for (or the fetch
+        # plane is wedged); either way the availability claim is being
+        # violated live.
+        age = ctx.gauge("worker.unserved_sync_age_seconds")
+        if age is not None and age > sync_age_max:
+            return {
+                "": {
+                    "unserved_sync_age_s": round(age, 1),
+                    "threshold": sync_age_max,
+                }
+            }
+        return {}
+
+    def helper_abuse(ctx: HealthContext) -> Dict[str, dict]:
+        # Over-limit BatchRequests: the honest requesting side chunks
+        # under the Helper cap, so any truncation is a peer exploiting
+        # the request→reply amplification (sync_flood).
+        v = ctx.counter("worker.helper_rejected_requests")
+        if v:
+            return {"": {"rejected_requests": v}}
+        return {}
+
+    def garbage_batches(ctx: HealthContext) -> Dict[str, dict]:
+        # Oversized batch frames rejected by the size gate: an honest
+        # worker's seals are bounded by batch_size, so these bytes are
+        # junk someone is trying to make us hash and persist.
+        v = ctx.counter("worker.garbage_batches")
+        if v:
+            return {"": {"garbage_batches": v}}
+        return {}
+
     def peer_unreachable(ctx: HealthContext) -> Dict[str, dict]:
         out = {}
         for peer, v in ctx.gauges_prefixed(
@@ -894,6 +940,14 @@ def default_rules(env: Optional[Mapping[str, str]] = None) -> List[HealthRule]:
             for_intervals=2,
             series=("primary.stale_messages",),
         ),
+        # for_intervals=2: the age gauge is a duration (the threshold
+        # debounces) but one extra interval rides out a sample racing the
+        # arrival-waiter's release, like quorum_wedge.
+        HealthRule("batch_withholding", batch_withholding, for_intervals=2),
+        # Latching, like equivocation: a single over-limit request or
+        # oversized batch frame is already proof of hostile traffic.
+        HealthRule("helper_abuse", helper_abuse),
+        HealthRule("garbage_batches", garbage_batches),
     ]
 
 

@@ -37,6 +37,13 @@ log = logging.getLogger("narwhal.network")
 _BACKOFF_START = 0.2
 _BACKOFF_CAP_DEFAULT = 60.0
 
+# How long connect failures against a NEVER-connected peer stay off the
+# health gauge (boot stagger ≠ dead validator; see _Connection).  Well
+# past any observed committee boot spread — wait_for_boot's own deadline
+# is 60 s — and short enough that a validator already dead at our start
+# is still named within the first minute.
+_NEVER_CONNECTED_GRACE_S = 45.0
+
 
 @functools.lru_cache(maxsize=8)
 def _parse_backoff_cap(raw: str) -> float:
@@ -152,7 +159,10 @@ def _peer_instruments(address: str):
       histogram (write → ACK, so it includes the peer's validation);
     - ``net.reliable.peer.retransmissions.<addr>`` — counter;
     - ``net.reliable.peer.consecutive_failures.<addr>`` — gauge, reset
-      to 0 on a successful connect (the peer_unreachable rule's input);
+      to 0 on a successful connect and reported only once the peer has
+      accepted at least one connection or the boot-grace window has
+      passed (boot-stagger must not read as a dead validator; the
+      peer_unreachable rule's input);
     - ``net.reliable.peer.backing_off.<addr>`` — 0/1 gauge.
     """
     return (
@@ -181,6 +191,19 @@ class _Connection:
         self.wakeup = asyncio.Event()
         self.backing_off = False  # reconnect backoff state (metrics gauge)
         self.failures = 0  # consecutive connect failures (health rule input)
+        # Whether this peer has EVER accepted a connection: failures are
+        # reported to the health plane only after it has (or after the
+        # boot-grace window below) — a committee boots staggered, and a
+        # peer that simply hasn't bound its socket yet is
+        # indistinguishable from our own early start.  Without the gate,
+        # a slow boot under a low reconnect-backoff cap crosses the
+        # peer_unreachable threshold and the latched FIRING event poisons
+        # the run's anomaly record (caught by a fuzzed scenario's CLEAN
+        # control arm firing peer_unreachable at boot).  A peer that dies
+        # later was necessarily connected once, so real deaths still fire
+        # within one evaluation interval.
+        self.ever_connected = False
+        self.created = asyncio.get_running_loop().time()
         (
             self._m_rtt,
             self._m_peer_retrans,
@@ -234,13 +257,23 @@ class _Connection:
                     _m_connect_fail.inc()
                     self.backing_off = True
                     self.failures += 1
-                    self._g_failures.set(self.failures)
+                    # Boot-grace only, never a permanent blind spot: a
+                    # peer that is ALREADY dead when this process starts
+                    # (e.g. we restarted while it stayed down) was never
+                    # connected, yet must still be reported once the
+                    # stagger window has safely passed.
+                    if self.ever_connected or (
+                        asyncio.get_running_loop().time() - self.created
+                        > _NEVER_CONNECTED_GRACE_S
+                    ):
+                        self._g_failures.set(self.failures)
                     self._g_backoff.set(1)
                     sleep_s, delay = next_backoff(delay)
                     await asyncio.sleep(sleep_s)
                     continue
                 delay = _BACKOFF_START
                 self.backing_off = False
+                self.ever_connected = True
                 self.failures = 0
                 self._g_failures.set(0)
                 self._g_backoff.set(0)

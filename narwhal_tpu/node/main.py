@@ -162,11 +162,16 @@ def main(argv=None) -> int:
         "--fault-plan",
         default=None,
         help="FAULT INJECTION: path to a Byzantine plan JSON "
-        "({behaviors, seed, withhold_targets, replay_interval_ms}) that "
-        "swaps this primary's Proposer/Core for their Byzantine wrappers "
-        "(narwhal_tpu/faults/byzantine.py).  The NARWHAL_FAULT_PLAN env "
-        "var is the equivalent knob for harnesses.  Never set this on a "
-        "node you care about: it makes the node ATTACK its committee.",
+        "({behaviors, seed, withhold_targets, replay_interval_ms, "
+        "flood_interval_ms, garbage_bytes}).  On a primary it swaps the "
+        "Proposer/Core for their Byzantine wrappers "
+        "(narwhal_tpu/faults/byzantine.py); on a worker it swaps the "
+        "BatchMaker/Helper and spawns the sync flooder "
+        "(narwhal_tpu/faults/byzantine_worker.py) — each role acts only "
+        "on its own plane's behaviors, so one plan file serves a whole "
+        "authority.  The NARWHAL_FAULT_PLAN env var is the equivalent "
+        "knob for harnesses.  Never set this on a node you care about: "
+        "it makes the node ATTACK its committee.",
     )
     run.add_argument(
         "--health-interval",
@@ -300,19 +305,27 @@ def main(argv=None) -> int:
                 _metrics.registry(), args.metrics_port
             )
 
-        if args.role == "primary":
-            fault_plan = None
-            plan_path = args.fault_plan or os.environ.get(
-                "NARWHAL_FAULT_PLAN"
-            )
-            if plan_path:
-                from ..faults.byzantine import ByzantinePlan
+        # One plan file serves a whole authority: each role acts only on
+        # its own plane's behaviors (primary.py / worker.py filter via
+        # primary_behaviors()/worker_behaviors()).
+        fault_plan = None
+        plan_path = args.fault_plan or os.environ.get("NARWHAL_FAULT_PLAN")
+        if plan_path:
+            from ..faults.byzantine import ByzantinePlan
 
-                fault_plan = ByzantinePlan.load(plan_path)
+            fault_plan = ByzantinePlan.load(plan_path)
+            active = (
+                fault_plan.primary_behaviors()
+                if args.role == "primary"
+                else fault_plan.worker_behaviors()
+            )
+            if active:
                 logging.getLogger("narwhal.node").warning(
-                    "FAULT INJECTION ACTIVE: byzantine behaviors %s",
-                    sorted(fault_plan.behaviors),
+                    "FAULT INJECTION ACTIVE: byzantine %s behaviors %s",
+                    args.role, sorted(active),
                 )
+
+        if args.role == "primary":
             node = await spawn_primary_node(
                 keypair,
                 committee,
@@ -330,6 +343,7 @@ def main(argv=None) -> int:
                 parameters,
                 store_path=f"{args.store}/store.log",
                 benchmark=args.benchmark,
+                fault_plan=fault_plan,
             )
         try:
             await stop.wait()  # run until SIGTERM/SIGINT

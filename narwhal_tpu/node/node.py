@@ -232,9 +232,19 @@ async def spawn_worker_node(
     parameters: Parameters,
     store_path: Optional[str] = None,
     benchmark: bool = False,
+    fault_plan=None,
 ) -> WorkerNode:
+    """``fault_plan`` wires the Byzantine worker wrappers (batch
+    withholding / garbage serving / sync flooding — the fault suite's
+    worker-plane adversary); None is the honest worker."""
     store = Store(store_path)
     worker = await Worker.spawn(
-        keypair.name, worker_id, committee, parameters, store, benchmark=benchmark
+        keypair.name,
+        worker_id,
+        committee,
+        parameters,
+        store,
+        benchmark=benchmark,
+        fault_plan=fault_plan,
     )
     return WorkerNode(worker, store)

@@ -168,7 +168,7 @@ class Primary:
         # channels — the adversary acts only at the network boundary).
         proposer_cls, core_cls = Proposer, Core
         extra: tuple = ()
-        if fault_plan is not None and fault_plan.behaviors:
+        if fault_plan is not None and fault_plan.primary_behaviors():
             from ..faults.byzantine import ByzantineCore, ByzantineProposer
 
             proposer_cls, core_cls = ByzantineProposer, ByzantineCore

@@ -1,0 +1,41 @@
+"""Shared env-var parsing for tunable limits.
+
+Every knob of the form "positive integer with a sane default" needs the
+same three behaviors: accept a valid override, fall back loudly on
+garbage, and warn ONCE rather than at call-site frequency (some of these
+are read on hot paths — per retry sweep, per inbound frame).  One
+definition here instead of a per-module copy (the reconnect-backoff cap
+in network/reliable_sender.py keeps its own parser: its semantics clamp
+to a float floor rather than requiring a positive integer).
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import os
+
+log = logging.getLogger("narwhal.config")
+
+
+@functools.lru_cache(maxsize=64)
+def _parse_positive_int(name: str, raw: str, default: int) -> int:
+    try:
+        v = int(raw)
+        if v > 0:
+            return v
+    except ValueError:
+        pass
+    log.warning(
+        "%s=%r is not a positive integer; using %d", name, raw, default
+    )
+    return default
+
+
+def positive_int(name: str, default: int) -> int:
+    """``int(os.environ[name])`` when set and positive, else ``default``
+    (with a once-per-value warning on garbage)."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    return _parse_positive_int(name, raw, default)

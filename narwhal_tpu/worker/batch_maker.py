@@ -204,12 +204,7 @@ class BatchMaker:
                 log.info("Batch %r contains sample tx %d", digest, sample_id)
             log.info("Batch %r contains %d B", digest, sealed.tx_bytes)
 
-        # Reliable-broadcast to our counterpart workers at every other
-        # authority; the ACK futures feed the quorum count.
-        handlers = [
-            (stake, self.sender.send(addr, sealed.message, msg_type="batch"))
-            for stake, addr in self._peers
-        ]
+        handlers = self._broadcast_batch(digest, sealed.message)
         item = (digest, sealed.message, handlers)
         try:
             self.out_queue.put_nowait(item)
@@ -239,6 +234,18 @@ class BatchMaker:
                 self._drain_task = self._loop.create_task(
                     self._drain_overflow()
                 )
+
+    def _broadcast_batch(self, digest, message: bytes):
+        """Reliable-broadcast the sealed batch to our counterpart workers
+        at every other authority; returns the ``[(stake, ack_future)]``
+        list the QuorumWaiter counts.  This is the quorum-ACK half of the
+        worker's availability split (the Helper serves the fetch half) —
+        the fault suite's ByzantineBatchMaker overrides exactly this seam
+        to under-share while still certifying."""
+        return [
+            (stake, self.sender.send(addr, message, msg_type="batch"))
+            for stake, addr in self._peers
+        ]
 
     async def _drain_overflow(self) -> None:
         while self._overflow:

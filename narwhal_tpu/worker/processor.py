@@ -13,6 +13,7 @@ import asyncio
 import logging
 import os
 
+from .. import metrics
 from ..config import WorkerId
 from ..crypto import digest32
 from ..messages import encode_batch_digest
@@ -35,6 +36,7 @@ class Processor:
         self.in_queue = in_queue
         self.out_queue = out_queue
         self.own_digests = own_digests
+        self._m_duplicates = metrics.counter("worker.duplicate_batches")
 
     async def run(self) -> None:
         while True:
@@ -46,6 +48,17 @@ class Processor:
             else:
                 serialized = item
                 digest = digest32(serialized)
+            if not self.own_digests and self.store.read(bytes(digest)) is not None:
+                # Re-delivered batch (helpful peers re-send during sync
+                # storms, and escalated BatchRequests fan out to several
+                # holders): the first delivery already persisted it and
+                # reported the digest, so a second store append + digest
+                # message would only grow the log and the primary's queue.
+                # Own sealed batches are exempt — they arrive over no
+                # network, and a (rare) byte-identical re-seal still owes
+                # the proposer its digest.
+                self._m_duplicates.inc()
+                continue
             self.store.write(bytes(digest), serialized)
             if _TRACE:
                 log.info("TRACE processed %r own=%s", digest, self.own_digests)

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
-from typing import List
+from typing import List, Optional
 
 from .. import metrics, native
 from ..config import Committee, Parameters, WorkerId
@@ -27,8 +27,9 @@ from ..messages import (
 )
 from ..network import Receiver, Writer
 from ..store import Store
+from ..utils.env import positive_int
 from .batch_maker import BatchMaker
-from .helper import Helper
+from .helper import Helper, max_request_digests
 from .primary_connector import PrimaryConnector
 from .processor import Processor
 from .quorum_waiter import QuorumWaiter
@@ -48,19 +49,56 @@ CHANNEL_CAPACITY = 1_000
 QUORUM_WINDOW = 8
 
 
+def max_batch_bytes(batch_size: int) -> int:
+    """Largest serialized batch frame this worker accepts, derived from
+    the committee's configured ``batch_size`` (an honest seal overshoots
+    the threshold by at most one transaction plus frame overhead) with
+    2x headroom plus 64 KiB of slack.  ``NARWHAL_MAX_BATCH_BYTES``
+    overrides — raise it for deployments whose single transactions
+    legitimately dwarf the batch threshold.  Anything larger is garbage
+    or hostile: without this gate a peer can make us SHA-512 and persist
+    megabytes of junk per frame (the fault suite's ``garbage_batches``
+    behavior), bounded only by the 32 MiB wire cap."""
+    return positive_int("NARWHAL_MAX_BATCH_BYTES", 2 * batch_size + 65_536)
+
+
+def max_request_bytes() -> int:
+    """Largest non-batch worker frame worth DECODING.  An over-cap
+    BatchRequest is truncated-and-served by the Helper (the documented
+    degradation), but decoding is itself O(frame) — a ~32 MiB hostile
+    request would allocate ~1M Digest objects before the cap dropped
+    99.99% of them.  Frames that could not possibly dedup down to the
+    cap get a length compare instead of a decode: 8x the cap's wire size
+    tolerates sloppy-but-honest senders (and the fault suite's own
+    1024-digest flood, which must reach the truncation path under the
+    default cap) while bounding the decode cost of a frame to ~8x what
+    the Helper would ever serve."""
+    # tag + count + digests + requestor key, at 8x the digest cap.
+    return 1 + 4 + 32 * (8 * max_request_digests()) + 32
+
+
 class WorkerReceiverHandler:
     """Other workers' traffic: ACK everything, route batches to the
     others-Processor and batch requests to the Helper
     (reference worker.rs:264-292)."""
 
     def __init__(
-        self, others_queue: asyncio.Queue, helper_queue: asyncio.Queue
+        self,
+        others_queue: asyncio.Queue,
+        helper_queue: asyncio.Queue,
+        max_batch_bytes: Optional[int] = None,
     ) -> None:
         self.others_queue = others_queue
         self.helper_queue = helper_queue
+        self.max_batch_bytes = max_batch_bytes
+        self._max_request_bytes = max_request_bytes()
         self._m_batches_in = metrics.counter("worker.batches_received")
         self._m_batch_bytes_in = metrics.counter("worker.batch_bytes_received")
         self._m_malformed = metrics.counter("worker.malformed_frames")
+        self._m_garbage = metrics.counter("worker.garbage_batches")
+        self._m_request_rejected = metrics.counter(
+            "worker.helper_rejected_requests"
+        )
 
     async def dispatch(self, writer: Writer, message: bytes) -> None:
         # Batches are large and their raw frame is the hashing/storage unit:
@@ -69,6 +107,22 @@ class WorkerReceiverHandler:
         # malformed batch is dropped un-ACKed, like the reference's
         # deserialization failure path (worker.rs:264-292).
         if message and message[0] == WORKER_BATCH:
+            if (
+                self.max_batch_bytes is not None
+                and len(message) > self.max_batch_bytes
+            ):
+                # Size gate BEFORE the structural walk and the 32 B hash:
+                # an oversized frame must cost us a length compare, not a
+                # multi-megabyte SHA-512 + store append (worker.rs has no
+                # equivalent; the garbage_batches fault scenario is the
+                # regression harness).  Counted for the `garbage_batches`
+                # health rule; dropped un-ACKed.
+                self._m_garbage.inc()
+                log.warning(
+                    "Dropping oversized batch frame (%d B > cap %d B)",
+                    len(message), self.max_batch_bytes,
+                )
+                return
             if native.validate_batch(message) < 0:
                 self._m_malformed.inc()
                 log.warning("Dropping malformed batch frame")
@@ -77,6 +131,17 @@ class WorkerReceiverHandler:
             self._m_batches_in.inc()
             self._m_batch_bytes_in.inc(len(message))
             await self.others_queue.put(message)
+            return
+        if len(message) > self._max_request_bytes:
+            # Same cost discipline as the batch size gate: a request
+            # frame too large to ever survive the Helper's dedup+cap is
+            # dropped for a length compare, not an O(frame) decode
+            # (counted into the helper_abuse rule's input).
+            self._m_request_rejected.inc()
+            log.warning(
+                "Dropping oversized batch-request frame (%d B > cap %d B)",
+                len(message), self._max_request_bytes,
+            )
             return
         try:
             decoded = decode_worker_message(message)
@@ -133,10 +198,35 @@ class Worker:
         parameters: Parameters,
         store: Store,
         benchmark: bool = False,
+        fault_plan=None,
     ) -> "Worker":
+        """``fault_plan`` (a ``narwhal_tpu.faults.byzantine.ByzantinePlan``
+        with worker behaviors) swaps the BatchMaker/Helper pair for their
+        Byzantine wrappers and spawns the sync flooder — the fault
+        suite's worker-plane adversary; None (the default) is the honest
+        worker."""
         self = cls(name, worker_id, committee, parameters, store, benchmark)
         loop = asyncio.get_running_loop()
         q = lambda: asyncio.Queue(maxsize=CHANNEL_CAPACITY)  # noqa: E731
+
+        # Byzantine wiring mirrors primary.py: same channels, same
+        # pipelines — the adversary acts only at the network boundary.
+        maker_cls, helper_cls = BatchMaker, Helper
+        extra: tuple = ()
+        flooder = None
+        if fault_plan is not None and fault_plan.worker_behaviors():
+            from ..faults.byzantine_worker import (
+                ByzantineBatchMaker,
+                ByzantineHelper,
+                SyncFlooder,
+            )
+
+            maker_cls, helper_cls = ByzantineBatchMaker, ByzantineHelper
+            extra = (fault_plan,)
+            if "sync_flood" in fault_plan.behaviors:
+                flooder = SyncFlooder(
+                    fault_plan, name, worker_id, committee, store
+                )
 
         to_quorum = asyncio.Queue(maxsize=QUORUM_WINDOW)
         own_batches = q()
@@ -166,7 +256,11 @@ class Worker:
         self.receivers.append(
             await Receiver.spawn(
                 addrs.worker_to_worker,
-                WorkerReceiverHandler(others_batches, helper_queue),
+                WorkerReceiverHandler(
+                    others_batches,
+                    helper_queue,
+                    max_batch_bytes=max_batch_bytes(parameters.batch_size),
+                ),
                 classify=frame_classifier(WORKER_FRAME_TYPES),
             )
         )
@@ -179,7 +273,8 @@ class Worker:
         )
 
         # Pipelines.
-        batch_maker = BatchMaker(
+        batch_maker = maker_cls(
+            *extra,
             name,
             worker_id,
             committee,
@@ -205,7 +300,7 @@ class Worker:
             sync_queue,
             gc_depth=parameters.gc_depth,
         )
-        helper = Helper(worker_id, committee, store, helper_queue)
+        helper = helper_cls(*extra, worker_id, committee, store, helper_queue)
         self.senders = [
             batch_maker.sender,
             connector.sender,
@@ -213,7 +308,7 @@ class Worker:
             helper.sender,
         ]
 
-        for runner in (
+        runners = [
             batch_maker,
             quorum_waiter,
             processor_own,
@@ -221,7 +316,11 @@ class Worker:
             connector,
             synchronizer,
             helper,
-        ):
+        ]
+        if flooder is not None:
+            runners.append(flooder)
+            self.senders.append(flooder.sender)
+        for runner in runners:
             self.tasks.append(loop.create_task(runner.run()))
         # The tx socket is bound inside BatchMaker.run; wait so clients can
         # connect as soon as spawn returns, and fail fast on a bind error.

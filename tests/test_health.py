@@ -232,8 +232,11 @@ def test_healthz_unmonitored_is_200():
 
 def test_reliable_sender_per_peer_rtt_and_failure_gauges():
     """A real send/ACK exchange must land per-peer observations under
-    names carrying the peer address; a dead peer must accumulate the
-    consecutive-failure gauge the peer_unreachable rule reads."""
+    names carrying the peer address; a peer that DIES must accumulate the
+    consecutive-failure gauge the peer_unreachable rule reads.  The dead
+    peer is connected once first: since the boot-stagger fix (a fuzzed
+    control arm fired peer_unreachable during a slow boot), failures only
+    reach the gauge for peers that have been seen alive."""
     from narwhal_tpu.network import Receiver, ReliableSender
     from tests.test_network import EchoAckHandler
 
@@ -247,8 +250,11 @@ def test_reliable_sender_per_peer_rtt_and_failure_gauges():
         ack = await asyncio.wait_for(sender.send(addr, b"ping"), 5)
         assert ack == b"Ack"
 
-        # Dead peer: unused port; connect failures accrue with backoff.
-        dead = "127.0.0.1:1"
+        # A once-alive peer dies: connect failures accrue with backoff.
+        dying = await Receiver.spawn("127.0.0.1:0", EchoAckHandler())
+        dead = f"127.0.0.1:{dying.port}"
+        await asyncio.wait_for(sender.send(dead, b"ping"), 5)
+        await dying.shutdown()
         sender.send(dead, b"void")
         for _ in range(200):
             g = reg.gauges.get(

@@ -185,3 +185,82 @@ def test_close_cancels_outstanding(run):
         assert fut.cancelled()
 
     run(go())
+
+
+def test_boot_stagger_failures_hidden_until_first_connect(run):
+    """Regression (fuzzed-scenario catch: a CLEAN control arm fired
+    peer_unreachable at boot): connect failures against a peer that has
+    never accepted a connection stay OFF the health gauge — a committee
+    boots staggered, and a not-yet-bound socket is not a dead validator.
+    Once the peer has been seen alive, failures count immediately."""
+    from narwhal_tpu import metrics
+
+    async def go():
+        probe = await Receiver.spawn("127.0.0.1:0", SilentHandler())
+        port = probe.port
+        await probe.shutdown()
+        addr = f"127.0.0.1:{port}"
+        gauge = lambda: metrics.registry().gauges[  # noqa: E731
+            f"net.reliable.peer.consecutive_failures.{addr}"
+        ].value
+
+        sender = ReliableSender()
+        fut = sender.send(addr, b"late")
+        deadline = asyncio.get_running_loop().time() + 5
+        conn = sender._connections[addr]
+        while conn.failures < 3:  # enough to cross the rule threshold
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.05)
+        assert gauge() == 0, "boot-time failures leaked to the health plane"
+
+        # Peer comes up: delivery completes, the peer is known-alive.
+        handler = EchoAckHandler()
+        recv = await Receiver.spawn(addr, handler)
+        assert await asyncio.wait_for(fut, 10) == b"Ack"
+        assert gauge() == 0 and conn.ever_connected
+
+        # NOW the peer dies: the very next connect failures are real
+        # and must reach the gauge (peer_unreachable's input).
+        await recv.shutdown()
+        sender.send(addr, b"into the void")
+        deadline = asyncio.get_running_loop().time() + 5
+        while gauge() < 1:
+            assert asyncio.get_running_loop().time() < deadline, (
+                "post-liveness failures never reached the gauge"
+            )
+            await asyncio.sleep(0.05)
+        sender.close()
+
+    run(go())
+
+
+def test_never_connected_peer_reported_after_boot_grace(run, monkeypatch):
+    """The boot-stagger suppression is a GRACE WINDOW, not a permanent
+    blind spot: a validator that is already dead when this process
+    starts (we restarted while it stayed down) must still reach the
+    consecutive-failures gauge once the grace passes."""
+    from narwhal_tpu import metrics
+    from narwhal_tpu.network import reliable_sender as rs
+
+    monkeypatch.setattr(rs, "_NEVER_CONNECTED_GRACE_S", 0.5)
+
+    async def go():
+        probe = await Receiver.spawn("127.0.0.1:0", SilentHandler())
+        port = probe.port
+        await probe.shutdown()
+        addr = f"127.0.0.1:{port}"
+
+        sender = ReliableSender()
+        sender.send(addr, b"into the void")
+        gauge = lambda: metrics.registry().gauges[  # noqa: E731
+            f"net.reliable.peer.consecutive_failures.{addr}"
+        ].value
+        deadline = asyncio.get_running_loop().time() + 8
+        while gauge() < 1:  # fires without EVER connecting
+            assert asyncio.get_running_loop().time() < deadline, (
+                "never-connected dead peer never reached the gauge"
+            )
+            await asyncio.sleep(0.05)
+        sender.close()
+
+    run(go())

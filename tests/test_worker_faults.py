@@ -1,0 +1,464 @@
+"""Worker-plane Byzantine behaviors (ISSUE 8 tentpole): the quorum-ACK vs
+availability split of ByzantineBatchMaker, the withholding/poisoning
+Helper, the sync-flood amplifier against the Helper's bounds, the new
+worker-plane health rules, the fuzzer's seed-determinism — and a live
+in-process committee surviving a withholding worker while naming it (the
+test_byzantine pattern: the paper's availability claim under attack)."""
+
+import asyncio
+import gc
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from narwhal_tpu import metrics, native  # noqa: E402
+from narwhal_tpu.config import Parameters  # noqa: E402
+from narwhal_tpu.crypto import Digest, digest32  # noqa: E402
+from narwhal_tpu.faults.byzantine import ByzantinePlan  # noqa: E402
+from narwhal_tpu.faults.byzantine_worker import (  # noqa: E402
+    ByzantineBatchMaker,
+    ByzantineHelper,
+    SyncFlooder,
+)
+from narwhal_tpu.faults.fuzz import generate  # noqa: E402
+from narwhal_tpu.faults.spec import SpecError, parse_scenario  # noqa: E402
+from narwhal_tpu.messages import (  # noqa: E402
+    decode_worker_message,
+    encode_batch,
+)
+from narwhal_tpu.metrics import HealthMonitor, default_rules  # noqa: E402
+from narwhal_tpu.network.framing import parse_address, write_frame  # noqa: E402
+from narwhal_tpu.node import spawn_primary_node, spawn_worker_node  # noqa: E402
+from narwhal_tpu.store import Store  # noqa: E402
+from narwhal_tpu.worker.helper import Helper  # noqa: E402
+from tests.common import committee, keys  # noqa: E402
+from tests.test_worker_hardening import FakeSender, _counter  # noqa: E402
+
+
+def _maker(plan, base_port=17000):
+    c = committee(base_port=base_port)
+    me = keys()[0].name
+    maker = ByzantineBatchMaker(
+        plan, me, 0, c, 200, 10_000,
+        c.worker(me, 0).transactions, asyncio.Queue(),
+    )
+    maker.sender.close()
+    maker.sender = FakeSender()
+    return maker, c, me
+
+
+# -- the quorum-ACK vs availability split -------------------------------------
+
+
+def test_withhold_split_certifies_but_starves_a_peer():
+    """The batch goes to exactly quorum−own_stake peers — enough ACKs to
+    certify — while the rest receive nothing and must sync."""
+
+    async def go():
+        plan = ByzantinePlan(["withhold_batches"], seed=9)
+        maker, c, me = _maker(plan)
+        before = _counter("faults.byzantine.batches_withheld")
+        handlers = maker._broadcast_batch(Digest(bytes(32)), b"batch")
+        # 4-node unit-stake committee: quorum 3, own stake 1 → 2 peers.
+        assert len(handlers) == 2
+        sent_to = {addr for addr, _ in maker.sender.sent}
+        all_peers = {addr for _, addr in maker._peers}
+        assert len(all_peers - sent_to) == 1  # one starved peer
+        assert sum(stake for stake, _ in handlers) + c.stake(me) \
+            >= c.quorum_threshold()
+        assert _counter("faults.byzantine.batches_withheld") == before + 1
+
+        # Seed-determinism: a fresh plan with the same seed splits the
+        # same way.
+        maker2, _, _ = _maker(ByzantinePlan(["withhold_batches"], seed=9))
+        maker2._broadcast_batch(Digest(bytes(32)), b"batch")
+        assert {a for a, _ in maker2.sender.sent} == sent_to
+
+    asyncio.run(asyncio.wait_for(go(), 10))
+
+
+def test_honest_behaviors_broadcast_to_everyone():
+    """A plan without the under-sharing behaviors (e.g. sync_flood only)
+    leaves the broadcast untouched."""
+
+    async def go():
+        maker, c, _ = _maker(ByzantinePlan(["sync_flood"], seed=9))
+        handlers = maker._broadcast_batch(Digest(bytes(32)), b"batch")
+        assert len(handlers) == 3  # every other authority
+
+    asyncio.run(asyncio.wait_for(go(), 10))
+
+
+def test_withhold_requires_unit_stake():
+    async def go():
+        c = committee(base_port=17030)
+        next(iter(c.authorities.values())).stake = 5
+        me = keys()[0].name
+        with pytest.raises(SpecError):
+            ByzantineBatchMaker(
+                ByzantinePlan(["withhold_batches"]), me, 0, c, 200, 10_000,
+                c.worker(me, 0).transactions, asyncio.Queue(),
+            )
+
+    asyncio.run(asyncio.wait_for(go(), 10))
+
+
+# -- the byzantine helper -----------------------------------------------------
+
+
+def test_withholding_helper_never_serves():
+    async def go():
+        c = committee(base_port=17060)
+        store = Store()
+        data = encode_batch([bytes(40)])
+        store.write(bytes(digest32(data)), data)
+        helper = ByzantineHelper(
+            ByzantinePlan(["withhold_batches"]), 0, c, store, asyncio.Queue()
+        )
+        helper.sender = FakeSender()
+        before = _counter("faults.byzantine.sync_requests_ignored")
+        await helper._respond("addr", [digest32(data)])
+        assert helper.sender.sent == []
+        assert _counter("faults.byzantine.sync_requests_ignored") == before + 1
+
+    asyncio.run(asyncio.wait_for(go(), 10))
+
+
+def test_garbage_helper_serves_oversized_and_corrupt_junk():
+    """Replies alternate between a structurally-valid OVERSIZED junk
+    batch (caught by the receiver's size gate) and a corrupt frame
+    (caught by the structural walk) — never the real bytes."""
+
+    async def go():
+        c = committee(base_port=17090)
+        store = Store()
+        data = encode_batch([bytes(40)])
+        store.write(bytes(digest32(data)), data)
+        helper = ByzantineHelper(
+            ByzantinePlan(["garbage_batches"], seed=3, garbage_bytes=2_000),
+            0, c, store, asyncio.Queue(),
+        )
+        helper.sender = FakeSender()
+        await helper._respond("addr", [digest32(data), digest32(data)])
+        assert len(helper.sender.sent) == 2
+        oversized = helper.sender.sent[0][1]
+        corrupt = helper.sender.sent[1][1]
+        assert oversized != data and corrupt != data
+        assert native.validate_batch(oversized) == 1  # valid structure...
+        assert len(oversized) == 2_000 + 9            # ...hostile size
+        assert native.validate_batch(corrupt) < 0
+        assert _counter("faults.byzantine.garbage_served") >= 2
+
+    asyncio.run(asyncio.wait_for(go(), 10))
+
+
+def test_garbage_reply_is_rejected_by_the_size_gate():
+    """End-to-end defense pairing: the garbage helper's oversized reply
+    trips the receiving worker's max-batch-bytes gate — counted into
+    worker.garbage_batches, not hashed or persisted."""
+
+    async def go():
+        from narwhal_tpu.worker.worker import WorkerReceiverHandler
+        from tests.test_worker_hardening import FakeWriter
+
+        helper = ByzantineHelper(
+            ByzantinePlan(["garbage_batches"], garbage_bytes=800_000),
+            0, committee(base_port=17120), Store(), asyncio.Queue(),
+        )
+        helper.sender = FakeSender()
+        await helper._respond("addr", [Digest(bytes(32))])
+        junk = helper.sender.sent[0][1]
+
+        handler = WorkerReceiverHandler(
+            asyncio.Queue(), asyncio.Queue(),
+            max_batch_bytes=2 * 500 + 65_536,
+        )
+        writer = FakeWriter()
+        before = _counter("worker.garbage_batches")
+        await handler.dispatch(writer, junk)
+        assert _counter("worker.garbage_batches") == before + 1
+        assert writer.acks == []
+
+    asyncio.run(asyncio.wait_for(go(), 10))
+
+
+# -- sync flood vs helper bounds ----------------------------------------------
+
+
+def test_flood_requests_exceed_cap_and_get_truncated():
+    async def go():
+        c = committee(base_port=17150)
+        store = Store()
+        data = encode_batch([bytes(40)])
+        store.write(bytes(digest32(data)), data)
+        flooder = SyncFlooder(
+            ByzantinePlan(["sync_flood"], seed=5), keys()[0].name, 0, c, store
+        )
+        digests = flooder._flood_digests()
+        assert len(digests) >= 1_024  # far past the Helper cap
+        assert digest32(data) in digests  # real stored digests lead
+
+        # The honest Helper truncates the flood to the cap and counts it.
+        victim = Helper(0, c, store, asyncio.Queue())
+        victim.sender = FakeSender()
+        before = _counter("worker.helper_rejected_requests")
+        bounded = victim._bound(digests, keys()[0].name)
+        assert len(bounded) <= victim.max_digests
+        assert _counter("worker.helper_rejected_requests") == before + 1
+
+    asyncio.run(asyncio.wait_for(go(), 10))
+
+
+# -- spec / plan composition --------------------------------------------------
+
+
+def test_plan_splits_behaviors_by_plane():
+    plan = ByzantinePlan(["equivocate", "withhold_batches"])
+    assert plan.primary_behaviors() == {"equivocate"}
+    assert plan.worker_behaviors() == {"withhold_batches"}
+
+
+def test_plan_and_spec_reject_withhold_garbage_conflict():
+    with pytest.raises(SpecError):
+        ByzantinePlan(["withhold_batches", "garbage_batches"])
+    with pytest.raises(SpecError):
+        parse_scenario(
+            {
+                "name": "t",
+                "byzantine": [
+                    {
+                        "node": 0,
+                        "behaviors": [
+                            "withhold_batches", "garbage_batches",
+                        ],
+                    },
+                ],
+            },
+            env={},
+        )
+
+
+def test_spec_rejects_duplicate_byzantine_entries_for_one_node():
+    """The runner writes ONE plan file per authority, so a second entry
+    for the same node would silently replace the first's behaviors —
+    refused at parse instead."""
+    with pytest.raises(SpecError):
+        parse_scenario(
+            {
+                "name": "t",
+                "byzantine": [
+                    {"node": 1, "behaviors": ["equivocate"]},
+                    {"node": 1, "behaviors": ["sync_flood"]},
+                ],
+            },
+            env={},
+        )
+
+
+def test_spec_accepts_worker_plane_composition():
+    s = parse_scenario(
+        {
+            "name": "t",
+            "nodes": 7,
+            "byzantine": [
+                {"node": 5, "behaviors": ["withhold_batches"],
+                 "flood_interval_ms": 100, "garbage_bytes": 1_000_000}
+            ],
+            "crash": [{"node": 2, "at_s": 10, "restart_at_s": 16}],
+        },
+        env={},
+    )
+    assert s.byzantine[0].flood_interval_ms == 100
+    assert s.byzantine[0].garbage_bytes == 1_000_000
+    # distinct byz + crashed nodes within f=2 for n=7
+    assert s.honest_nodes() == [0, 1, 2, 3, 4, 6]
+
+
+def test_spec_rejects_worker_plane_composition_past_f():
+    with pytest.raises(SpecError):
+        parse_scenario(
+            {
+                "name": "t",
+                "byzantine": [
+                    {"node": 3, "behaviors": ["withhold_batches"]}
+                ],
+                "crash": [{"node": 1, "at_s": 10, "restart_at_s": 16}],
+            },
+            env={},
+        )
+
+
+# -- new health rules ---------------------------------------------------------
+
+
+def test_worker_plane_rules_fire_and_stay_silent_when_clean():
+    reg = metrics.Registry(enabled=True)
+    monitor = HealthMonitor(
+        reg, rules=default_rules({"NARWHAL_HEALTH_SYNC_AGE_S": "2"}),
+        interval_s=1.0,
+    )
+    # Clean registry: nothing fires.
+    assert monitor.evaluate(now=1.0) == []
+
+    reg.counter("worker.helper_rejected_requests").inc()
+    reg.counter("worker.garbage_batches").inc()
+    reg.gauge_fn("worker.unserved_sync_age_seconds", lambda: 5.0)
+    monitor.evaluate(now=2.0)
+    firing = {f["rule"] for f in monitor.evaluate(now=3.0)}
+    assert {"helper_abuse", "garbage_batches", "batch_withholding"} <= firing
+
+    # The age gauge clearing (batch finally served) clears the rule; the
+    # two latching rules stay raised — the events are proof.
+    reg.gauge_fns["worker.unserved_sync_age_seconds"] = lambda: 0.0
+    monitor.evaluate(now=4.0)
+    firing = {f["rule"] for f in monitor.evaluate(now=5.0)}
+    assert "batch_withholding" not in firing
+    assert {"helper_abuse", "garbage_batches"} <= firing
+
+
+# -- fuzzed scenario generation -----------------------------------------------
+
+
+def test_fuzz_is_deterministic_and_valid():
+    for seed in range(40):
+        obj = generate(seed)
+        assert obj == generate(seed), f"seed {seed} not deterministic"
+        s = parse_scenario(obj, env={})  # schema + BFT bounds revalidate
+        assert s.name == f"fuzz_{seed}"
+        assert s.byzantine, "every fuzz draw carries a byzantine plane"
+        assert s.expect_rules, "detection verdict must never be vacuous"
+        # All faults land on one node: union ≤ f by construction.
+        faulted = set(s.byzantine_nodes()) | {c.node for c in s.crash}
+        assert len(faulted) == 1
+
+
+def test_fuzz_varies_across_seeds():
+    draws = [generate(seed) for seed in range(40)]
+    behaviors = {tuple(d["byzantine"][0]["behaviors"]) for d in draws}
+    assert len(behaviors) >= 5, "fuzzer barely varies behaviors"
+    assert any("crash" in d for d in draws)
+    assert any("wan" in d for d in draws)
+    assert any("crash" not in d for d in draws)
+
+
+def test_fuzz_spec_roundtrips_through_json():
+    import json
+
+    for seed in (7, 23):
+        obj = generate(seed)
+        assert json.loads(json.dumps(obj)) == obj
+
+
+# -- live committee: availability under attack --------------------------------
+
+
+def _tx(i: int) -> bytes:
+    return bytes([1]) + (0xFB0000 + i).to_bytes(8, "little") + bytes(91)
+
+
+def test_withholding_worker_detected_and_committee_survives():
+    """One authority's worker certifies batches it then refuses to serve
+    (the availability attack the paper's certificate claim rules out).
+    The committee must keep committing the other authorities' payload,
+    recover the withheld bytes via retry escalation to the honest ACKers,
+    and the starved worker must NAME the anomaly via batch_withholding."""
+    reg = metrics.registry()
+    reg.reset()
+    gc.collect()  # drop earlier tests' synchronizers from the age gauge
+
+    async def go():
+        c = committee(base_port=17200)
+        params = Parameters(
+            header_size=32,
+            max_header_delay=100,
+            batch_size=400,
+            max_batch_delay=100,
+            sync_retry_delay=4_000,
+        )
+        kps = keys()
+        commits = {i: [] for i in range(4)}
+        plan = ByzantinePlan(["withhold_batches"], seed=5)
+        nodes = []
+        for i, kp in enumerate(kps):
+            nodes.append(
+                await spawn_primary_node(
+                    kp, c, params,
+                    on_commit=lambda cert, i=i: commits[i].append(cert),
+                )
+            )
+            nodes.append(
+                await spawn_worker_node(
+                    kp, 0, c, params,
+                    fault_plan=plan if i == 3 else None,
+                )
+            )
+
+        monitor = HealthMonitor(
+            reg,
+            rules=default_rules({"NARWHAL_HEALTH_SYNC_AGE_S": "1"}),
+            interval_s=0.5,
+        )
+        age_gauge = reg.gauge_fns["worker.unserved_sync_age_seconds"]
+
+        async def send_txs(ids, node=0):
+            host, port = parse_address(c.worker(kps[node].name, 0).transactions)
+            _, w = await asyncio.open_connection(host, port)
+            txs = [_tx(i) for i in ids]
+            for tx in txs:
+                await write_frame(w, tx)
+            w.close()
+            return {digest32(encode_batch(txs))}
+
+        async def wait_commit(expected, nodes_idx, timeout_s=60):
+            for _ in range(int(timeout_s / 0.1)):
+                if all(
+                    expected
+                    <= {
+                        d
+                        for cert in commits[i]
+                        for d in cert.header.payload
+                    }
+                    for i in nodes_idx
+                ):
+                    return
+                await asyncio.sleep(0.1)
+            raise AssertionError(
+                f"payload never committed on {nodes_idx}: "
+                f"{[len(commits[i]) for i in nodes_idx]}"
+            )
+
+        # Honest payload commits with the adversary active from boot.
+        batch1 = await send_txs(range(4))
+        await wait_commit(batch1, range(3))
+
+        # Drive payload through the WITHHOLDING worker: it certifies
+        # (quorum-split ACKs) but one honest peer is starved and must
+        # sync against a refusing Helper.
+        byz_batch = await send_txs(range(50, 54), node=3)
+        deadline = asyncio.get_running_loop().time() + 30
+        while age_gauge() <= 1.0:
+            assert asyncio.get_running_loop().time() < deadline, (
+                "no starved sync request ever aged past the threshold"
+            )
+            await asyncio.sleep(0.05)
+        assert _counter("faults.byzantine.batches_withheld") > 0
+        monitor.evaluate()
+        firing = {f["rule"] for f in monitor.evaluate()}
+        assert "batch_withholding" in firing, firing
+
+        # Availability holds regardless: escalation reaches the honest
+        # ACK-quorum holders, so even the WITHHELD payload commits...
+        await wait_commit(byz_batch, range(3))
+        # ... and fresh honest payload kept flowing throughout.
+        batch3 = await send_txs(range(100, 104), node=1)
+        await wait_commit(batch3, range(3))
+
+        for node in nodes:
+            await node.shutdown()
+
+    # 8 in-process nodes on pure-Python crypto: generous ceiling so a
+    # loaded shared-core host doesn't flake the suite.
+    asyncio.run(asyncio.wait_for(go(), 180))

@@ -1,0 +1,395 @@
+"""Worker-plane graceful-degradation hardening (ISSUE 8 satellites): the
+Synchronizer's jittered capped exponential retry backoff, the Helper's
+per-request digest bounds, the Processor's re-delivery dedup, and the
+receiver's batch-size gate.  These are the defenses the worker-plane
+fault scenarios (byzantine_worker.py) attack — each test here is the
+deterministic unit twin of a fault_bench scenario."""
+
+import asyncio
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from narwhal_tpu import metrics  # noqa: E402
+from narwhal_tpu.crypto import Digest, digest32  # noqa: E402
+from narwhal_tpu.messages import (  # noqa: E402
+    decode_worker_message,
+    encode_batch,
+)
+from narwhal_tpu.store import Store  # noqa: E402
+from narwhal_tpu.worker.helper import Helper, max_request_digests  # noqa: E402
+from narwhal_tpu.worker.processor import Processor  # noqa: E402
+from narwhal_tpu.worker.synchronizer import Synchronizer  # noqa: E402
+from narwhal_tpu.worker.worker import (  # noqa: E402
+    WorkerReceiverHandler,
+    max_batch_bytes,
+)
+from tests.common import (  # noqa: E402
+    batch_digest,
+    committee,
+    keys,
+    serialized_batch,
+)
+
+
+class FakeSender:
+    """Recording stand-in for Simple/ReliableSender: every send lands in
+    ``sent`` synchronously — no sockets, no scheduling jitter."""
+
+    def __init__(self):
+        self.sent = []  # (address, data)
+
+    def send(self, address, data, msg_type="other"):
+        self.sent.append((address, data))
+
+    def lucky_broadcast(self, addresses, data, nodes, msg_type="other"):
+        self.sent.append(("lucky", data))
+
+    def close(self):
+        pass
+
+
+class FakeWriter:
+    def __init__(self):
+        self.acks = []
+
+    async def send(self, data):
+        self.acks.append(data)
+
+
+def _counter(name):
+    c = metrics.registry().counters.get(name)
+    return c.value if c is not None else 0
+
+
+def _digest(i: int) -> Digest:
+    return Digest(bytes([i % 256]) * 32)
+
+
+# -- synchronizer retry backoff ----------------------------------------------
+
+
+def _make_sync(store=None, retry_ms=1_000, seed=7):
+    c = committee()
+    sync = Synchronizer(
+        keys()[0].name, 0, c, store or Store(), retry_ms, 3,
+        asyncio.Queue(), rng=random.Random(seed),
+    )
+    sync.sender = FakeSender()
+    return sync
+
+
+def test_one_request_per_backoff_window_and_windows_grow():
+    """A pending digest is re-requested exactly once per backoff window,
+    and the windows double (with 50-100% jitter) toward the cap — not the
+    old fixed-cadence flood."""
+
+    async def go():
+        sync = _make_sync(retry_ms=1_000)
+        d = _digest(1)
+        await sync._synchronize([d], keys()[1].name)
+        assert len(sync.sender.sent) == 1  # the initial optimistic ask
+        p = sync.pending[d]
+        assert p.due == p.first_ts + 1.0  # first window un-jittered
+
+        # Sweeps INSIDE a window never re-send.
+        assert sync._retry_sweep(now=p.first_ts + 0.5) == 0
+        assert sync._retry_sweep(now=p.first_ts + 0.99) == 0
+
+        # Crossing the window re-sends exactly once and re-arms.
+        assert sync._retry_sweep(now=p.first_ts + 1.0) == 1
+        assert len(sync.sender.sent) == 2
+        assert sync._retry_sweep(now=p.first_ts + 1.01) == 0
+
+        # Drive 6 more windows: each sleep is jitter(delay) with delay
+        # doubling toward the 60 s default cap — so the observed windows
+        # must grow beyond any fixed cadence and stay under the cap.
+        windows = [p.due - p.first_ts - 1.0]  # first retry window
+        now = p.due
+        for _ in range(6):
+            assert sync._retry_sweep(now=now) == 1
+            windows.append(p.due - now)
+            now = p.due
+        # delay sequence 1,2,4,8,16,32,60; jitter in [0.5,1.0]x.
+        for i, w in enumerate(windows):
+            expected = min(2.0 ** i, 60.0)
+            assert 0.5 * expected - 1e-9 <= w <= expected + 1e-9, (i, w)
+        assert windows[-1] > 10 * windows[0], "backoff never escalated"
+
+        for t in sync._waiters.values():
+            t.cancel()
+
+    asyncio.run(asyncio.wait_for(go(), 10))
+
+
+def test_resolved_digest_not_rerequested_mid_tick():
+    """A digest whose batch landed in the store — even before the
+    notify_read waiter task has had a chance to clear `pending` — must
+    drop out of the retry sweep immediately."""
+
+    async def go():
+        store = Store()
+        sync = _make_sync(store=store, retry_ms=100)
+        d, still_missing = _digest(2), _digest(3)
+        await sync._synchronize([d, still_missing], keys()[1].name)
+        store.write(bytes(d), b"batch-bytes")  # waiter hasn't run yet
+        assert d in sync.pending  # the race window under test
+        n = sync._retry_sweep(now=sync.pending[d].due + 1)
+        assert n == 1  # only the still-missing sibling escalated
+        _, data = sync.sender.sent[-1]
+        kind, digests, _ = decode_worker_message(data)
+        assert kind == "batch_request"
+        assert digests == [still_missing]
+        for t in sync._waiters.values():
+            t.cancel()
+
+    asyncio.run(asyncio.wait_for(go(), 10))
+
+
+def test_requests_chunk_under_helper_cap():
+    """Both the initial ask and the retry escalation split their digest
+    lists into frames of at most the Helper's per-request cap, so an
+    honest sync storm never reads as the sync_flood attack."""
+
+    async def go():
+        cap = max_request_digests()
+        sync = _make_sync(retry_ms=100)
+        digests = [
+            Digest(i.to_bytes(2, "big") * 16) for i in range(cap + 40)
+        ]
+        await sync._synchronize(digests, keys()[1].name)
+        assert len(sync.sender.sent) == 2  # ceil((cap+40)/cap)
+        for _, data in sync.sender.sent:
+            kind, got, _ = decode_worker_message(data)
+            assert kind == "batch_request" and len(got) <= cap
+
+        sync.sender.sent.clear()
+        now = max(p.due for p in sync.pending.values()) + 1
+        assert sync._retry_sweep(now=now) == cap + 40
+        assert len(sync.sender.sent) == 2
+        for _, data in sync.sender.sent:
+            _, got, _ = decode_worker_message(data)
+            assert len(got) <= cap
+        for t in sync._waiters.values():
+            t.cancel()
+
+    asyncio.run(asyncio.wait_for(go(), 10))
+
+
+def test_unserved_sync_age_gauge_tracks_oldest():
+    # Collect synchronizers leaked by earlier tests first: the gauge
+    # reads the oldest pending entry across EVERY live instance.
+    import gc
+
+    gc.collect()
+
+    async def go():
+        gauge = metrics.registry().gauge_fns["worker.unserved_sync_age_seconds"]
+        sync = _make_sync()
+        base = gauge()
+        await sync._synchronize([_digest(4)], keys()[1].name)
+        await asyncio.sleep(0.15)
+        assert gauge() >= 0.15 - 1e-3
+        # Resolution clears the pending entry (waiter runs) → age drops.
+        sync.store.write(bytes(_digest(4)), b"x")
+        await asyncio.sleep(0.05)
+        assert sync.pending == {}
+        assert gauge() == base == 0.0
+
+    asyncio.run(asyncio.wait_for(go(), 10))
+
+
+# -- helper request bounds ----------------------------------------------------
+
+
+def test_helper_truncates_and_counts_over_limit_request():
+    """An over-limit BatchRequest is served only up to the cap, the
+    remainder is dropped (not amplified), and the abuse is counted."""
+
+    async def go():
+        c = committee()
+        store = Store()
+        frames = {}
+        for i in range(200):
+            data = encode_batch([bytes([i % 256]) * 40])
+            frames[digest32(data)] = data
+            store.write(bytes(digest32(data)), data)
+        helper = Helper(0, c, store, asyncio.Queue())
+        helper.sender = FakeSender()
+        assert helper.max_digests == 128
+
+        before = _counter("worker.helper_rejected_requests")
+        digests = list(frames)  # 200 > cap
+        await helper._respond(
+            "addr", helper._bound(digests, keys()[1].name)
+        )
+        assert len(helper.sender.sent) == 128  # truncated, not amplified
+        assert _counter("worker.helper_rejected_requests") == before + 1
+
+        # Duplicate digests within one request dedup to ONE serve — for
+        # free, NOT counted as abuse (the counter feeds a latching rule;
+        # an under-cap request with duplicates must not brand the peer).
+        helper.sender.sent.clear()
+        one = digests[0]
+        await helper._respond(
+            "addr", helper._bound([one] * 50, keys()[1].name)
+        )
+        assert len(helper.sender.sent) == 1
+        assert _counter("worker.helper_rejected_requests") == before + 1
+
+        # An in-bounds request is served in full with no rejection.
+        helper.sender.sent.clear()
+        await helper._respond(
+            "addr", helper._bound(digests[:100], keys()[1].name)
+        )
+        assert len(helper.sender.sent) == 100
+        assert _counter("worker.helper_rejected_requests") == before + 1
+
+    asyncio.run(asyncio.wait_for(go(), 10))
+
+
+def test_helper_cap_env_override(monkeypatch):
+    monkeypatch.setenv("NARWHAL_HELPER_MAX_DIGESTS", "7")
+    assert max_request_digests() == 7
+    monkeypatch.setenv("NARWHAL_HELPER_MAX_DIGESTS", "bogus")
+    assert max_request_digests() == 128
+    monkeypatch.delenv("NARWHAL_HELPER_MAX_DIGESTS")
+    assert max_request_digests() == 128
+
+
+# -- processor dedup ----------------------------------------------------------
+
+
+def test_duplicate_deliveries_store_and_report_once():
+    """N duplicate deliveries of one batch (sync-storm re-sends) yield
+    ONE store write and ONE digest message toward the primary."""
+
+    async def go():
+        store = Store()
+        writes = []
+        orig = store.write
+        store.write = lambda k, v: (writes.append(k), orig(k, v))
+        in_q, out_q = asyncio.Queue(), asyncio.Queue()
+        proc = Processor(0, store, in_q, out_q, own_digests=False)
+        task = asyncio.get_running_loop().create_task(proc.run())
+        before = _counter("worker.duplicate_batches")
+        for _ in range(5):
+            await in_q.put(serialized_batch())
+        msg = await asyncio.wait_for(out_q.get(), 5)
+        await asyncio.sleep(0.1)  # let the duplicates drain
+        assert out_q.empty(), "duplicate digest message reached the primary"
+        assert writes == [bytes(batch_digest())]
+        assert _counter("worker.duplicate_batches") == before + 4
+        assert msg is not None
+        task.cancel()
+
+    asyncio.run(asyncio.wait_for(go(), 10))
+
+
+def test_own_batches_exempt_from_dedup():
+    """A byte-identical own re-seal still reports its digest: the dedup
+    gate applies only to network re-deliveries."""
+
+    async def go():
+        store = Store()
+        in_q, out_q = asyncio.Queue(), asyncio.Queue()
+        proc = Processor(0, store, in_q, out_q, own_digests=True)
+        task = asyncio.get_running_loop().create_task(proc.run())
+        for _ in range(2):
+            await in_q.put((batch_digest(), serialized_batch()))
+        await asyncio.wait_for(out_q.get(), 5)
+        await asyncio.wait_for(out_q.get(), 5)  # second one NOT suppressed
+        task.cancel()
+
+    asyncio.run(asyncio.wait_for(go(), 10))
+
+
+# -- batch size validation ----------------------------------------------------
+
+
+def test_oversized_batch_rejected_uncounted_unacked():
+    async def go():
+        others_q, helper_q = asyncio.Queue(), asyncio.Queue()
+        handler = WorkerReceiverHandler(others_q, helper_q, max_batch_bytes=512)
+        writer = FakeWriter()
+        before = _counter("worker.garbage_batches")
+
+        # A structurally VALID but oversized junk batch: the size gate
+        # must reject it before any hashing/persisting.
+        junk = b"\x00" + (1).to_bytes(4, "little") \
+            + (2_000).to_bytes(4, "little") + bytes(2_000)
+        await handler.dispatch(writer, junk)
+        assert _counter("worker.garbage_batches") == before + 1
+        assert writer.acks == [] and others_q.empty()
+
+        # A truncated frame fails the structural walk (malformed path).
+        m_before = _counter("worker.malformed_frames")
+        truncated = b"\x00" + (3).to_bytes(4, "little") + b"\x77"
+        await handler.dispatch(writer, truncated)
+        assert _counter("worker.malformed_frames") == m_before + 1
+        assert writer.acks == [] and others_q.empty()
+
+        # An in-bounds valid batch still flows: ACK + queued.
+        await handler.dispatch(writer, serialized_batch())
+        assert writer.acks == [b"Ack"]
+        assert await asyncio.wait_for(others_q.get(), 1) == serialized_batch()
+
+    asyncio.run(asyncio.wait_for(go(), 10))
+
+
+def test_max_batch_bytes_default_and_override(monkeypatch):
+    assert max_batch_bytes(500_000) == 2 * 500_000 + 65_536
+    monkeypatch.setenv("NARWHAL_MAX_BATCH_BYTES", "123456")
+    assert max_batch_bytes(500_000) == 123_456
+    monkeypatch.setenv("NARWHAL_MAX_BATCH_BYTES", "junk")
+    assert max_batch_bytes(1_000) == 2 * 1_000 + 65_536
+
+
+def test_absurd_request_frame_dropped_before_decode(monkeypatch):
+    """A BatchRequest frame too large to ever survive the Helper's
+    dedup+cap is dropped on a length compare — the decode itself is
+    O(frame), and the sync_flood attacker must not convert capped reply
+    amplification into request-decode CPU burn."""
+
+    async def go():
+        from narwhal_tpu import messages
+        from narwhal_tpu.worker.worker import max_request_bytes
+
+        others_q, helper_q = asyncio.Queue(), asyncio.Queue()
+        handler = WorkerReceiverHandler(others_q, helper_q, max_batch_bytes=None)
+        writer = FakeWriter()
+        decodes = []
+        orig = messages.decode_worker_message
+        monkeypatch.setattr(
+            "narwhal_tpu.worker.worker.decode_worker_message",
+            lambda m: (decodes.append(1), orig(m))[1],
+        )
+
+        before = _counter("worker.helper_rejected_requests")
+        huge = bytes([1]) + bytes(max_request_bytes() + 100)
+        await handler.dispatch(writer, huge)
+        assert decodes == [], "oversized request frame reached the decoder"
+        assert _counter("worker.helper_rejected_requests") == before + 1
+        assert writer.acks == [] and helper_q.empty()
+
+        # The fault suite's own 1024-digest flood sits UNDER the byte
+        # gate (8x the digest cap): it must still reach the Helper's
+        # truncation path, not be silently pre-dropped.
+        flood = encode_batch_request_1024()
+        assert len(flood) <= max_request_bytes()
+        await handler.dispatch(writer, flood)
+        assert decodes == [1]
+        assert not helper_q.empty()
+
+    def encode_batch_request_1024():
+        from narwhal_tpu.crypto import Digest
+        from narwhal_tpu.messages import encode_batch_request
+
+        return encode_batch_request(
+            [Digest(i.to_bytes(2, "big") * 16) for i in range(1024)],
+            keys()[0].name,
+        )
+
+    asyncio.run(asyncio.wait_for(go(), 10))
