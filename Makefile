@@ -10,8 +10,8 @@
 
 PYTHON ?= python
 
-.PHONY: check native lint test test-ci metrics-smoke fault-smoke \
-	fault-fuzz-smoke trajectory bench clean
+.PHONY: check native lint lint-invariants test test-ci metrics-smoke \
+	fault-smoke fault-fuzz-smoke trajectory bench clean
 
 check: native lint test
 
@@ -28,6 +28,17 @@ lint:
 	else \
 		echo "flake8 not installed; syntax compile check only"; \
 	fi
+	$(PYTHON) -m narwhal_tpu.analysis
+
+# Invariant linter alone, with the JSON findings report for the CI
+# artifact upload (the `lint-invariants` job): AST rules over
+# narwhal_tpu/ + benchmark/ — no-blocking-in-async, task-retention,
+# wire-type coverage, metric-name drift, env-var registry + README
+# env-table drift.  Nonzero exit on any non-pragma'd finding.
+lint-invariants:
+	mkdir -p .ci-artifacts
+	$(PYTHON) -m narwhal_tpu.analysis \
+		--report .ci-artifacts/lint-invariants.json
 
 test:
 	$(PYTHON) -m pytest tests/ -x -q

@@ -40,6 +40,8 @@ import numpy as np
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+from narwhal_tpu.utils.env import env_str  # noqa: E402
+
 BITS, LIMBS, MASK, FOLD = 8, 32, 255, 38
 
 
@@ -128,7 +130,7 @@ def main() -> None:
     # live mul would silently desynchronize the A/B arms.  Cross-check the
     # copy against the LIVE mul on a random sub-batch before measuring, so
     # drift fails loudly here instead of corrupting layout comparisons.
-    if os.environ.get("NARWHAL_FIELD_DTYPE", "int32") == "int32":
+    if env_str("NARWHAL_FIELD_DTYPE") == "int32":
         from narwhal_tpu.ops import field25519 as F
 
         k = min(args.batch, 512)

@@ -22,6 +22,7 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+from narwhal_tpu.utils.env import env_flag  # noqa: E402
 from narwhal_tpu.config import (  # noqa: E402
     Authority,
     Committee,
@@ -33,6 +34,7 @@ from narwhal_tpu.config import (  # noqa: E402
 from narwhal_tpu.crypto import KeyPair  # noqa: E402
 from benchmark.logs import parse_logs  # noqa: E402
 from benchmark.metrics_check import (  # noqa: E402
+    loop_stall_summary,
     build_timeline,
     check_quiesce_health,
     cross_validate,
@@ -199,6 +201,7 @@ def run_bench(
     tpu_primaries: int = None,
     scrape_interval: float = 1.0,
     progress_wait: float = 0.0,
+    loop_watchdog_ms: int = 0,
 ):
     """Run one committee + clients on localhost; return the ParseResult.
 
@@ -255,13 +258,19 @@ def run_bench(
     host_pp = os.environ.get("PYTHONPATH", "")
     tpu_pp = os.pathsep.join(p for p in [REPO, host_pp] if p)
     tpu_env = dict(os.environ, PYTHONPATH=tpu_pp)
+    if loop_watchdog_ms:
+        # Loop-stall watchdog smoke arm: every node measures its own
+        # event-loop stalls into runtime.loop_stall_seconds; the bench
+        # JSON's `runtime` section joins them per node after the run.
+        cpu_env["NARWHAL_LOOP_WATCHDOG_MS"] = str(loop_watchdog_ms)
+        tpu_env["NARWHAL_LOOP_WATCHDOG_MS"] = str(loop_watchdog_ms)
     procs = []
     primary_logs, worker_logs, client_logs = [], [], []
     metrics_paths = []
     # NARWHAL_METRICS=0 stubs the registry in every child — the knob the
     # overhead measurement flips; cross-validation is skipped since the
     # snapshots would be empty.
-    metrics_on = os.environ.get("NARWHAL_METRICS", "1") != "0"
+    metrics_on = env_flag("NARWHAL_METRICS")
     # Live scrape plane: every node also gets a --metrics-port in the
     # block directly after the committee's own ports (metrics_port), and
     # the harness polls them all during the run (benchmark/scraper.py)
@@ -481,6 +490,7 @@ def run_bench(
         cross_validate(result, snapshots, tx_size)
         # Wire-goodput + crypto-cost ledger sections (the `wire` and
         # `crypto` keys of the bench JSON).
+        result.runtime = loop_stall_summary(snapshots)
         wc = wire_crypto_summary(
             snapshots,
             committed_payload_bytes=result.committed_bytes,
@@ -524,6 +534,15 @@ def main():
     )
     parser.add_argument("--max-header-delay", type=int, default=100)
     parser.add_argument("--json", action="store_true")
+    parser.add_argument(
+        "--loop-watchdog-ms",
+        type=int,
+        default=0,
+        help="Arm the event-loop stall watchdog on every node "
+        "(NARWHAL_LOOP_WATCHDOG_MS) and emit the per-node `runtime` "
+        "section (runtime.loop_stall_seconds series) in the bench JSON; "
+        "0 = off",
+    )
     parser.add_argument("--crypto-backend", choices=["cpu", "tpu"], default=None)
     parser.add_argument(
         "--experimental-consensus-kernel",
@@ -555,6 +574,7 @@ def main():
         crypto_backend=args.crypto_backend,
         consensus_kernel=args.consensus_kernel,
         tpu_primaries=args.tpu_primaries,
+        loop_watchdog_ms=args.loop_watchdog_ms,
     )
     if result.errors:
         print("ERRORS detected in logs:", file=sys.stderr)
@@ -587,6 +607,9 @@ def main():
                     # bandwidth (retransmits split out), goodput ratio,
                     # per-site sign/verify attribution + protocol check.
                     "wire": result.wire,
+                    # Loop-stall watchdog series (when the run armed it):
+                    # per-node runtime.loop_stall_seconds + last stack.
+                    "runtime": result.runtime,
                     "crypto": result.crypto,
                     # Live committee timeline (scraper): per-node series,
                     # per-peer RTT matrix, /healthz verdicts at quiesce.

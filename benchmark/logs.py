@@ -112,6 +112,10 @@ class ParseResult:
     # sign/verify attribution with the protocol-arithmetic cross-check.
     wire: Dict = field(default_factory=dict)
     crypto: Dict = field(default_factory=dict)
+    # Per-node event-loop stall series (metrics_check.loop_stall_summary),
+    # populated when the run armed the loop-stall watchdog
+    # (NARWHAL_LOOP_WATCHDOG_MS / local_bench --loop-watchdog-ms).
+    runtime: Dict = field(default_factory=dict)
 
     def summary(self, rate: int, tx_size: int, nodes: int, workers: int) -> str:
         return (

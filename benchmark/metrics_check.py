@@ -57,6 +57,38 @@ def load_snapshots(paths: List[str], errors: List[str]) -> List[dict]:
     return snaps
 
 
+def loop_stall_summary(snapshots: List[dict]) -> Dict[str, dict]:
+    """Per-node event-loop stall series for the bench JSON `runtime`
+    section (populated when the committee ran with
+    NARWHAL_LOOP_WATCHDOG_MS set — the loop-watchdog smoke arm).  Keyed
+    by node pid; a node whose snapshot carries the histogram at count 0
+    still appears, which is the point: "the watchdog ran and saw no
+    stall" is a measurement, not an absence."""
+    out: Dict[str, dict] = {}
+    for snap in snapshots:
+        hist = (snap.get("histograms") or {}).get("runtime.loop_stall_seconds")
+        if hist is None:
+            continue
+        last = dict(
+            (snap.get("detail") or {}).get("runtime.loop_stall_last") or {}
+        )
+        if "stack" in last:
+            last["stack"] = str(last["stack"])[:2000]
+        out[str(snap.get("pid", len(out)))] = {
+            "loop_stall_seconds": {
+                "count": int(hist.get("count", 0)),
+                "sum_s": round(float(hist.get("sum", 0.0)), 4),
+                "mean_s": round(float(hist.get("mean", 0.0)), 4),
+                "buckets": hist.get("buckets", []),
+            },
+            "stalls": int(
+                (snap.get("counters") or {}).get("runtime.loop_stalls", 0)
+            ),
+            "last_stall": last,
+        }
+    return out
+
+
 def cross_validate(
     result,
     snapshots: List[dict],

@@ -45,6 +45,7 @@ from ..primary.core import Core
 from ..primary.messages import Header, Vote, encode_primary_message
 from ..primary.proposer import Proposer
 from .spec import BYZANTINE_BEHAVIORS, SpecError
+from ..utils.tasks import spawn
 
 log = logging.getLogger("narwhal.faults")
 
@@ -295,9 +296,7 @@ class ByzantineCore(Core):
     async def run(self) -> None:
         replay_task = None
         if "replay_stale" in self.plan.behaviors:
-            replay_task = asyncio.get_running_loop().create_task(
-                self._replay_loop()
-            )
+            replay_task = spawn(self._replay_loop(), name="byz-replay")
         try:
             await super().run()
         finally:

@@ -32,12 +32,14 @@ from __future__ import annotations
 import asyncio
 import collections
 import json
-import os
 import random
 import time
 import zlib
 from dataclasses import dataclass
 from typing import Deque, Dict, List, Optional, Tuple
+
+from ..utils.env import env_str
+from ..utils.tasks import spawn
 
 
 @dataclass(frozen=True)
@@ -192,7 +194,7 @@ class _ShapedWriter:
         self._wake = asyncio.Event()
         self._exc: Optional[BaseException] = None
         self._loop = asyncio.get_running_loop()
-        self._task = self._loop.create_task(self._pump())
+        self._task = spawn(self._pump(), name="netem-pump")
 
     def write(self, data: bytes) -> None:
         self._buf += data
@@ -264,10 +266,10 @@ def emulator() -> Optional[NetEmulator]:
     global _EMULATOR, _LOADED
     if not _LOADED:
         _LOADED = True
-        path = os.environ.get("NARWHAL_FAULT_NETEM")
+        path = env_str("NARWHAL_FAULT_NETEM")
         if path:
             _EMULATOR = NetEmulator.load(
-                path, os.environ.get("NARWHAL_FAULT_NODE", "")
+                path, env_str("NARWHAL_FAULT_NODE")
             )
     return _EMULATOR
 

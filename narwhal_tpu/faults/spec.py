@@ -41,6 +41,8 @@ import os
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from ..utils.env import env_raw
+
 PRIMARY_BEHAVIORS = (
     "equivocate",       # two conflicting headers per round, disjoint peer sets
     "wrong_key",        # headers broadcast with a rogue-key signature
@@ -197,7 +199,21 @@ def parse_scenario(
     nodes = int(obj.get("nodes", 4))
     _require(4 <= nodes <= 10, "nodes must be in [4, 10] (one-host committee)")
 
-    seed = int(env.get("NARWHAL_FAULT_SEED", obj.get("seed", 0)))
+    # The override must fail LOUD on garbage (unlike the warn-and-default
+    # registry accessors): the fault suite's premise is byte-for-byte
+    # replayability from a seed, and a silently-ignored override would
+    # run a different stochastic draw than the one the operator asked
+    # to reproduce while the artifact claims otherwise.
+    raw_seed = env_raw("NARWHAL_FAULT_SEED", env=env)
+    if raw_seed is not None:
+        try:
+            seed = int(raw_seed)
+        except ValueError:
+            raise SpecError(
+                f"NARWHAL_FAULT_SEED={raw_seed!r} is not an integer"
+            ) from None
+    else:
+        seed = int(obj.get("seed", 0))
 
     byz = []
     for b in obj.get("byzantine", []):

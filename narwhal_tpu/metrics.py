@@ -58,6 +58,8 @@ from typing import (
     Tuple,
 )
 
+from .utils.env import env_flag, env_float, env_int
+
 log = logging.getLogger("narwhal.metrics")
 
 # Latency buckets (seconds): 1 ms … 10 s, roughly log-spaced.  Chosen to
@@ -693,10 +695,10 @@ class HealthContext:
 def default_rules(env: Optional[Mapping[str, str]] = None) -> List[HealthRule]:
     """The built-in rule set; every threshold has a NARWHAL_HEALTH_* env
     override (documented in README 'Observability')."""
-    env = os.environ if env is None else env
-
     def f(key: str, default: float) -> float:
-        return float(env.get(key, default))
+        # The registry (utils/env.py) declares the same default; passing
+        # it here too keeps each threshold readable next to its rule.
+        return float(env_float(key, default, env=env))
 
     lag_max = f("NARWHAL_HEALTH_MAX_COMMIT_LAG", 20)
     stall_s = f("NARWHAL_HEALTH_COMMIT_STALL_S", 10)
@@ -980,7 +982,7 @@ class HealthMonitor:
         self.registry = reg
         self.rules = default_rules() if rules is None else rules
         self.interval_s = (
-            float(os.environ.get("NARWHAL_HEALTH_INTERVAL", "1.0"))
+            env_float("NARWHAL_HEALTH_INTERVAL")
             if interval_s is None
             else interval_s
         )
@@ -1142,12 +1144,12 @@ class HealthMonitor:
 # -- the per-process default registry ----------------------------------------
 
 def _enabled_from_env() -> bool:
-    return os.environ.get("NARWHAL_METRICS", "1") != "0"
+    return env_flag("NARWHAL_METRICS")
 
 
 _REGISTRY = Registry(
     enabled=_enabled_from_env(),
-    trace_cap=int(os.environ.get("NARWHAL_TRACE_CAP", "32768")),
+    trace_cap=env_int("NARWHAL_TRACE_CAP"),
 )
 
 
@@ -1301,7 +1303,7 @@ class MetricsServer:
         if host is None:
             host = (
                 "0.0.0.0"
-                if os.environ.get("NARWHAL_BIND_ANY") == "1"
+                if env_flag("NARWHAL_BIND_ANY")
                 else "127.0.0.1"
             )
         self = cls(reg)

@@ -7,10 +7,11 @@ from __future__ import annotations
 
 import asyncio
 import logging
-import os
 from typing import Protocol
 
 from .. import metrics
+from ..utils.env import env_flag
+from ..utils.tasks import spawn
 from .framing import (
     STREAM_LIMIT,
     FrameError,
@@ -75,7 +76,7 @@ class Receiver:
         # whenever the reachable address is not on a local interface
         # (NAT'd/cloud public IPs); the reference node rewrites its listen
         # IP to 0.0.0.0 unconditionally (primary.rs:97-104).
-        if os.environ.get("NARWHAL_BIND_ANY") == "1":
+        if env_flag("NARWHAL_BIND_ANY"):
             host = "0.0.0.0"
         self._server = await asyncio.start_server(
             self._on_connection, host, port, limit=STREAM_LIMIT
@@ -92,9 +93,7 @@ class Receiver:
         if self._closing:
             writer.close()
             return
-        task = asyncio.get_running_loop().create_task(
-            self._handle(reader, writer)
-        )
+        task = spawn(self._handle(reader, writer))
         self._connections.add(task)
         task.add_done_callback(self._connections.discard)
 

@@ -15,13 +15,14 @@ import asyncio
 import collections
 import functools
 import logging
-import os
 import random
 import weakref
 from typing import Deque, Dict, List, Optional, Sequence, Tuple
 
 from .. import metrics
 from ..faults import netem as _netem
+from ..utils.env import env_raw
+from ..utils.tasks import spawn
 from .framing import (
     MAX_FRAME,
     STREAM_LIMIT,
@@ -66,7 +67,7 @@ def backoff_cap() -> float:
     peer but wrong for a short partition: every sender that backed off to
     the cap takes up to a minute to notice the heal.  Fault scenarios
     (and latency-sensitive deployments) lower it."""
-    raw = os.environ.get("NARWHAL_NET_BACKOFF_MAX_S")
+    raw = env_raw("NARWHAL_NET_BACKOFF_MAX_S")
     if raw is None:
         return _BACKOFF_CAP_DEFAULT
     return _parse_backoff_cap(raw)
@@ -210,7 +211,7 @@ class _Connection:
             self._g_failures,
             self._g_backoff,
         ) = _peer_instruments(address)
-        self.task = asyncio.get_running_loop().create_task(self._keep_alive())
+        self.task = spawn(self._keep_alive(), name="reliable-sender-conn")
 
     def push(self, data: bytes, fut: asyncio.Future, msg_type: str) -> None:
         self.buffer.append(_Msg(data, fut, msg_type))

@@ -13,6 +13,7 @@ from typing import Dict, Sequence
 
 from .. import metrics
 from ..faults import netem as _netem
+from ..utils.tasks import spawn
 from .framing import (
     STREAM_LIMIT,
     parse_address,
@@ -35,7 +36,7 @@ class _Peer:
     def __init__(self, address: str) -> None:
         self.address = address
         self.queue: asyncio.Queue = asyncio.Queue(maxsize=_QUEUE_CAP)
-        self.task = asyncio.get_running_loop().create_task(self._run())
+        self.task = spawn(self._run(), name="simple-sender-peer")
 
     async def _run(self) -> None:
         host, port = parse_address(self.address)
@@ -57,7 +58,7 @@ class _Peer:
                 continue  # drop this message; try fresh on the next one
             # Drain-and-discard replies (e.g. ACKs) so the peer's writes
             # don't stall; best-effort senders ignore response content.
-            drain = asyncio.get_running_loop().create_task(self._drain(reader))
+            drain = spawn(self._drain(reader))
             try:
                 while True:
                     await write_frame(writer, data)

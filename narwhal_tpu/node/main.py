@@ -16,8 +16,11 @@ import os
 import signal
 import sys
 
+from ..analysis.watchdog import install_from_env as install_loop_watchdog
 from ..config import Committee, Parameters, export_keypair, load_keypair
 from ..crypto import KeyPair
+from ..utils.env import env_flag, env_float, env_str
+from ..utils.tasks import spawn
 from .node import spawn_primary_node, spawn_worker_node
 
 
@@ -59,7 +62,7 @@ def setup_logging(
     # level is applied to the whole `narwhal.*` hierarchy — every module
     # logs under it (narwhal.worker, narwhal.primary, narwhal.consensus,
     # narwhal.network, narwhal.node, narwhal.client, narwhal.metrics).
-    level_name = level_name or os.environ.get("NARWHAL_LOG")
+    level_name = level_name or env_str("NARWHAL_LOG")
     if level_name:
         level = getattr(logging, level_name.upper(), None)
         if not isinstance(level, int):
@@ -278,28 +281,28 @@ def main(argv=None) -> int:
         snapshot_task = None
         metrics_server = None
         health_task = None
+        # Loop-stall watchdog (NARWHAL_LOOP_WATCHDOG_MS): measured proof
+        # that no callback holds this node's event loop — the runtime
+        # half of the narwhal-lint invariant suite.
+        loop_watchdog = install_loop_watchdog()
         if args.metrics_path:
-            snapshot_task = asyncio.get_running_loop().create_task(
+            snapshot_task = spawn(
                 _metrics.SnapshotWriter(
                     _metrics.registry(),
                     args.metrics_path,
                     interval_s=args.metrics_interval,
-                ).run()
+                ).run(),
+                name="metrics-snapshot",
             )
         # Live health: always on when metrics are (cost: one rule sweep
         # per interval).  Attached to the registry so snapshots carry a
         # `health` section and /healthz answers from it.
-        if (
-            _metrics.registry().enabled
-            and os.environ.get("NARWHAL_HEALTH", "1") != "0"
-        ):
+        if _metrics.registry().enabled and env_flag("NARWHAL_HEALTH"):
             monitor = _metrics.HealthMonitor(
                 _metrics.registry(), interval_s=args.health_interval
             )
             _metrics.registry().health = monitor
-            health_task = asyncio.get_running_loop().create_task(
-                monitor.run()
-            )
+            health_task = spawn(monitor.run(), name="health-monitor")
         if args.metrics_port:
             metrics_server = await _metrics.MetricsServer.spawn(
                 _metrics.registry(), args.metrics_port
@@ -309,7 +312,7 @@ def main(argv=None) -> int:
         # its own plane's behaviors (primary.py / worker.py filter via
         # primary_behaviors()/worker_behaviors()).
         fault_plan = None
-        plan_path = args.fault_plan or os.environ.get("NARWHAL_FAULT_PLAN")
+        plan_path = args.fault_plan or env_str("NARWHAL_FAULT_PLAN")
         if plan_path:
             from ..faults.byzantine import ByzantinePlan
 
@@ -366,31 +369,23 @@ def main(argv=None) -> int:
                 # snapshot on disk covers the whole run.
                 snapshot_task.cancel()
                 await asyncio.gather(snapshot_task, return_exceptions=True)
+            if loop_watchdog is not None:
+                await loop_watchdog.shutdown()
 
     # NARWHAL_FAULTHANDLER_S=<seconds>: C-level watchdog that dumps every
     # thread's stack to stderr each interval — it fires even when the
     # event loop is wedged in CPU-bound Python (where nothing above the
     # loop can log), which is exactly the state a fault-suite post-mortem
     # needs to see.  Debug aid; off by default.
-    dump_s = os.environ.get("NARWHAL_FAULTHANDLER_S")
-    if dump_s:
-        try:
-            interval = float(dump_s)
-        except ValueError:
-            logging.getLogger("narwhal.node").warning(
-                "NARWHAL_FAULTHANDLER_S=%r is not a number; watchdog "
-                "disabled",
-                dump_s,
-            )
-            interval = 0.0
-        if interval > 0:
-            import faulthandler
+    interval = env_float("NARWHAL_FAULTHANDLER_S")
+    if interval and interval > 0:
+        import faulthandler
 
-            faulthandler.dump_traceback_later(interval, repeat=True)
+        faulthandler.dump_traceback_later(interval, repeat=True)
 
     # NARWHAL_PROFILE=<dir>: cProfile the whole node, dumping stats on
     # SIGTERM (the harness sends SIGTERM before SIGKILL for this reason).
-    profile_dir = os.environ.get("NARWHAL_PROFILE")
+    profile_dir = env_str("NARWHAL_PROFILE")
     profiler = None
     if profile_dir:
         import cProfile

@@ -11,10 +11,11 @@ from __future__ import annotations
 
 import asyncio
 import logging
-import os
 from typing import Callable, List, Optional
 
 from ..config import Committee, Parameters, WorkerId
+from ..utils.env import env_str
+from ..utils.tasks import spawn
 from ..consensus import Consensus
 from ..crypto import KeyPair
 from ..primary import Primary
@@ -82,7 +83,7 @@ async def spawn_primary_node(
     golden-oracle safety replay."""
     node = PrimaryNode()
     if audit_path is None:
-        audit_path = os.environ.get("NARWHAL_CONSENSUS_AUDIT") or None
+        audit_path = env_str("NARWHAL_CONSENSUS_AUDIT") or None
     loop = asyncio.get_running_loop()
     node.store = Store(store_path)
 
@@ -142,7 +143,7 @@ async def spawn_primary_node(
         benchmark=benchmark,
         fault_plan=fault_plan,
     )
-    node.tasks.append(loop.create_task(consensus.run()))
+    node.tasks.append(spawn(consensus.run(), name="consensus"))
 
     async def analyze() -> None:
         while True:
@@ -150,7 +151,7 @@ async def spawn_primary_node(
             if on_commit is not None:
                 on_commit(certificate)
 
-    node.tasks.append(loop.create_task(analyze()))
+    node.tasks.append(spawn(analyze(), name="analyze"))
 
     # Far-frontier restore, second half (found by the crash/restart fault
     # scenario): the checkpoint anchors the committed FRONTIER, but the
@@ -165,10 +166,11 @@ async def spawn_primary_node(
     # up so the consensus GC feedback loop is already draining.
     if store_path is not None:
         node.tasks.append(
-            loop.create_task(
+            spawn(
                 _replay_persisted_certificates(
                     node.store, consensus.tusk.state, tx_new_certificates
-                )
+                ),
+                name="certificate-replay",
             )
         )
     return node

@@ -18,9 +18,10 @@ import jax as _jax
 # Persistent XLA compilation cache: the verify/commit kernels take tens of
 # seconds to compile on a TPU terminal; cache them across node processes
 # (every primary spawns fresh in the bench harness).
-_cache_dir = _os.environ.get(
-    "NARWHAL_JAX_CACHE",
-    _os.path.join(_os.path.expanduser("~"), ".cache", "narwhal_tpu_jax"),
+from ..utils.env import env_str as _env_str
+
+_cache_dir = _env_str("NARWHAL_JAX_CACHE") or _os.path.join(
+    _os.path.expanduser("~"), ".cache", "narwhal_tpu_jax"
 )
 try:
     _jax.config.update("jax_compilation_cache_dir", _cache_dir)

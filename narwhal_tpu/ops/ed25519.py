@@ -29,7 +29,6 @@ adversarial inputs (tests/test_ed25519.py).
 from __future__ import annotations
 
 import hashlib
-import os
 from typing import List, Sequence, Tuple
 
 import numpy as np
@@ -37,6 +36,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..utils.env import env_str
 from . import field25519 as F
 
 P = F.P
@@ -433,7 +433,7 @@ class TpuBackend:
         two pad shape up to it is compiled before the node joins.  Explicit
         ``shapes`` or NARWHAL_TPU_WARMUP_SHAPES="16,64,256" override."""
         if shapes is None:
-            env = os.environ.get("NARWHAL_TPU_WARMUP_SHAPES")
+            env = env_str("NARWHAL_TPU_WARMUP_SHAPES")
             if env:
                 shapes = [int(s) for s in env.split(",") if s]
             else:

@@ -38,12 +38,13 @@ tighter f32 bounds are noted where they differ).
 
 from __future__ import annotations
 
-import os
 
 import numpy as np
 
 import jax
 import jax.numpy as jnp
+
+from ..utils.env import env_str
 
 BITS = 8
 LIMBS = 32
@@ -53,7 +54,7 @@ P = (1 << 255) - 19
 # 2^(BITS·LIMBS) = 2^256 ≡ 38 (mod p): folding multiplier for limbs ≥ LIMBS.
 FOLD = 38
 
-_DTYPE_ENV = os.environ.get("NARWHAL_FIELD_DTYPE", "int32")
+_DTYPE_ENV = env_str("NARWHAL_FIELD_DTYPE")
 if _DTYPE_ENV not in ("int32", "float32"):
     # Fail loud: a typo ("f32", "fp32") silently falling back to int32
     # would mislabel every measurement made under it.

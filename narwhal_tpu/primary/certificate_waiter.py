@@ -17,6 +17,7 @@ from ..messages import Round
 from ..store import Store
 from .core import AtomicRound
 from .messages import Certificate
+from ..utils.tasks import spawn
 
 log = logging.getLogger("narwhal.primary")
 
@@ -43,9 +44,7 @@ class CertificateWaiter:
                 certificate = await self.rx_synchronizer.get()
                 digest = certificate.digest()
                 if digest not in self.pending:
-                    task = asyncio.get_running_loop().create_task(
-                        self._wait(certificate)
-                    )
+                    task = spawn(self._wait(certificate))
                     self.pending[digest] = (certificate.round, task)
                 self._gc()
         finally:

@@ -38,7 +38,6 @@ from __future__ import annotations
 import asyncio
 import logging
 import hashlib
-import os
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from .. import metrics
@@ -47,6 +46,7 @@ from ..crypto import Digest, PublicKey, SignatureService
 from ..messages import Round
 from ..network import ReliableSender
 from ..store import Store
+from ..utils.env import env_flag
 from ..utils.serde import Writer
 from .aggregators import CertificatesAggregator, VotesAggregator
 from .errors import (
@@ -123,7 +123,7 @@ class Core:
         # Vote fast path (coalesced persist-before-vote); the env knob is
         # the A/B arm selector for bench_cadence.py.
         if fast_path is None:
-            fast_path = os.environ.get("NARWHAL_VOTE_FAST_PATH", "1") != "0"
+            fast_path = env_flag("NARWHAL_VOTE_FAST_PATH")
         self.fast_path = fast_path
 
         self.gc_round: Round = 0

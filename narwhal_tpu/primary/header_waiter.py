@@ -24,6 +24,7 @@ from ..store import Store
 from .core import AtomicRound
 from .messages import Header, encode_certificates_request
 from .synchronizer import payload_key
+from ..utils.tasks import spawn
 
 log = logging.getLogger("narwhal.primary")
 
@@ -60,7 +61,7 @@ class HeaderWaiter:
         self.parent_requests: Dict[Digest, Tuple[Round, float]] = {}
 
     async def run(self) -> None:
-        timer = asyncio.get_running_loop().create_task(self._timer())
+        timer = spawn(self._timer(), name="header-waiter-timer")
         try:
             while True:
                 message = await self.rx_synchronizer.get()
@@ -122,7 +123,7 @@ class HeaderWaiter:
         self._park(header, [bytes(d) for d in missing])
 
     def _park(self, header: Header, keys: List[bytes]) -> None:
-        task = asyncio.get_running_loop().create_task(self._wait(header, keys))
+        task = spawn(self._wait(header, keys))
         self.pending[header.id] = (header.round, task)
 
     async def _wait(self, header: Header, keys: List[bytes]) -> None:

@@ -23,6 +23,7 @@ from ..messages import (
 )
 from ..network import Receiver, Writer
 from ..store import Store
+from ..utils.tasks import spawn
 from .certificate_waiter import CertificateWaiter
 from .core import AtomicRound, Core
 from .garbage_collector import GarbageCollector
@@ -124,18 +125,21 @@ class Primary:
         # via Proposer.deliver_parents, a synchronous same-loop callback
         # (skips the queue round-trip on the round-cadence critical path).
 
-        # Queue-depth gauges, polled only at snapshot/scrape time.
-        for gname, gq in (
-            ("primary.queue.primaries", tx_primaries),
-            ("primary.queue.helper", tx_helper),
-            ("primary.queue.our_digests", rx_our_digests),
-            ("primary.queue.others_digests", rx_others_digests),
-            ("primary.queue.header_waiter", tx_headers_loopback),
-            ("primary.queue.cert_waiter", tx_certs_loopback),
-            ("primary.queue.own_headers", tx_own_headers),
-            ("primary.queue.consensus", tx_consensus),
-        ):
-            metrics.gauge_fn(gname, gq.qsize)
+        # Queue-depth gauges, polled only at snapshot/scrape time.  One
+        # literal call per name (no loop) so the metric-name-drift lint
+        # rule can see every registered name statically.
+        metrics.gauge_fn("primary.queue.primaries", tx_primaries.qsize)
+        metrics.gauge_fn("primary.queue.helper", tx_helper.qsize)
+        metrics.gauge_fn("primary.queue.our_digests", rx_our_digests.qsize)
+        metrics.gauge_fn(
+            "primary.queue.others_digests", rx_others_digests.qsize
+        )
+        metrics.gauge_fn(
+            "primary.queue.header_waiter", tx_headers_loopback.qsize
+        )
+        metrics.gauge_fn("primary.queue.cert_waiter", tx_certs_loopback.qsize)
+        metrics.gauge_fn("primary.queue.own_headers", tx_own_headers.qsize)
+        metrics.gauge_fn("primary.queue.consensus", tx_consensus.qsize)
 
         consensus_round = AtomicRound()
         metrics.gauge_fn(
@@ -235,7 +239,9 @@ class Primary:
             proposer,
             helper,
         ):
-            self.tasks.append(loop.create_task(runner.run()))
+            self.tasks.append(
+                spawn(runner.run(), name=type(runner).__name__.lower())
+            )
         self.senders = [
             core.network,
             garbage_collector.sender,

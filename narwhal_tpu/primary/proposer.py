@@ -25,10 +25,11 @@ from __future__ import annotations
 
 import asyncio
 import logging
-import os
 from typing import List, Optional, Tuple
 
-_TRACE = bool(os.environ.get("NARWHAL_TRACE"))
+from ..utils.env import env_flag
+
+_TRACE = env_flag("NARWHAL_TRACE")
 
 from .. import metrics
 from ..config import Committee, WorkerId

@@ -11,16 +11,16 @@ from __future__ import annotations
 
 import asyncio
 import logging
-import os
 from typing import Dict, List, Optional
 
 from ..config import Committee
 from ..crypto import Digest, PublicKey
 from ..store import Store
+from ..utils.env import env_flag
 from .messages import Certificate, Header, genesis
 
 log = logging.getLogger("narwhal.primary")
-_TRACE = bool(os.environ.get("NARWHAL_TRACE"))
+_TRACE = env_flag("NARWHAL_TRACE")
 
 
 def payload_key(digest: Digest, worker_id: int) -> bytes:

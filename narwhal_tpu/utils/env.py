@@ -1,12 +1,27 @@
-"""Shared env-var parsing for tunable limits.
+"""The NARWHAL_* environment-variable registry and its typed accessors.
 
-Every knob of the form "positive integer with a sane default" needs the
-same three behaviors: accept a valid override, fall back loudly on
-garbage, and warn ONCE rather than at call-site frequency (some of these
-are read on hot paths — per retry sweep, per inbound frame).  One
-definition here instead of a per-module copy (the reconnect-backoff cap
-in network/reliable_sender.py keeps its own parser: its semantics clamp
-to a float floor rather than requiring a positive integer).
+Every env knob the runtime (or the bench harness) reads is DECLARED here
+— name, type, documented default, one doc line — and read through the
+typed accessors below.  Two consumers keep the registry honest:
+
+- the invariant linter (``python -m narwhal_tpu.analysis``): any
+  ``NARWHAL_*`` literal in the tree that is not declared here fails the
+  ``env-var-registry`` rule, as does a direct ``os.environ`` read outside
+  this module and a declared entry nothing reads;
+- the README "Environment variables" table is generated from this
+  registry (``python -m narwhal_tpu.analysis --env-table``) and
+  drift-checked by the same lint run, so the doc cannot rot.
+
+Parsing behavior shared by every accessor: accept a valid override, fall
+back LOUDLY on garbage, and warn once per (name, raw value) rather than
+at call-site frequency (some of these are read on hot paths — per retry
+sweep, per inbound frame).  Flags parse uniformly: unset → the declared
+default; set → false only for ``0``/empty/``false``/``no``/``off``
+(case-insensitive), true otherwise.
+
+The reconnect-backoff cap in network/reliable_sender.py keeps its own
+parser on top of :func:`env_raw` — its semantics clamp to a float floor
+rather than falling back on garbage.
 """
 
 from __future__ import annotations
@@ -14,8 +29,321 @@ from __future__ import annotations
 import functools
 import logging
 import os
+from dataclasses import dataclass
+from typing import Dict, Mapping, Optional
 
 log = logging.getLogger("narwhal.config")
+
+_UNSET = object()
+
+
+@dataclass(frozen=True)
+class EnvVar:
+    """One declared knob.  ``default`` is the value the accessors fall
+    back to when the variable is unset (``None`` = no value / feature
+    off); ``shown_default`` overrides how the README table renders it
+    when the effective default is computed at the call site."""
+
+    name: str
+    kind: str  # "flag" | "int" | "float" | "str"
+    default: object
+    doc: str
+    shown_default: Optional[str] = None
+
+    @property
+    def rendered_default(self) -> str:
+        if self.shown_default is not None:
+            return self.shown_default
+        if self.default is None:
+            return "unset"
+        if self.kind == "flag":
+            return "1" if self.default else "0"
+        return str(self.default)
+
+
+_VARS = [
+    # -- core runtime ---------------------------------------------------------
+    EnvVar(
+        "NARWHAL_LOG", "str", None,
+        "Log level for the whole `narwhal.*` hierarchy (equivalent of "
+        "`node run --log-level`; wins over `-v`).",
+    ),
+    EnvVar(
+        "NARWHAL_BIND_ANY", "flag", False,
+        "Listen on 0.0.0.0 instead of the advertised committee IP "
+        "(NAT'd/cloud hosts); applies to every listener including the "
+        "metrics endpoint.",
+    ),
+    EnvVar(
+        "NARWHAL_VOTE_FAST_PATH", "flag", True,
+        "`0` restores per-header vote persists instead of the coalesced "
+        "once-per-burst vote-log flush (round-cadence fast path, PR 5).",
+    ),
+    EnvVar(
+        "NARWHAL_NET_BACKOFF_MAX_S", "float", 60.0,
+        "Reconnect-backoff ceiling in seconds (floor 0.2 s). Lower it "
+        "for fault scenarios / latency-sensitive deployments so healed "
+        "partitions are noticed quickly.",
+    ),
+    EnvVar(
+        "NARWHAL_HELPER_MAX_DIGESTS", "int", 128,
+        "Per-BatchRequest digest cap at the worker Helper; unique "
+        "digests past the cap are truncated and counted as "
+        "`worker.helper_rejected_requests`.",
+    ),
+    EnvVar(
+        "NARWHAL_MAX_BATCH_BYTES", "int", None,
+        "Inbound batch-frame size ceiling at the worker receiver; "
+        "oversized frames are rejected before hashing into "
+        "`worker.garbage_batches`.",
+        shown_default="2×batch_size + 64 KiB",
+    ),
+    EnvVar(
+        "NARWHAL_CONSENSUS_AUDIT", "str", None,
+        "Path for the consensus insert/commit audit segment consumed by "
+        "the golden-oracle safety replay; unset = no audit log.",
+    ),
+    # -- observability --------------------------------------------------------
+    EnvVar(
+        "NARWHAL_METRICS", "flag", True,
+        "`0` swaps the per-process instrument registry for no-ops "
+        "(instrumented code needs no enabled-checks).",
+    ),
+    EnvVar(
+        "NARWHAL_METRICS_DUMP", "str", None,
+        "Directory where the metrics-smoke and health-bench tests drop "
+        "their registry snapshots / committee timelines for CI artifact "
+        "upload.",
+    ),
+    EnvVar(
+        "NARWHAL_TRACE", "flag", False,
+        "Per-digest TRACE instrumentation plus worker heartbeat logs "
+        "(hot-path cost; debugging aid).",
+    ),
+    EnvVar(
+        "NARWHAL_TRACE_CAP", "int", 32_768,
+        "Stage-trace table capacity before eviction "
+        "(`metrics.trace_evictions` counts overflow).",
+    ),
+    EnvVar(
+        "NARWHAL_HEALTH", "flag", True,
+        "HealthMonitor master switch on node boot; `0` disables rule "
+        "evaluation entirely.",
+    ),
+    EnvVar(
+        "NARWHAL_HEALTH_INTERVAL", "float", 1.0,
+        "Seconds between health-rule sweeps.",
+    ),
+    EnvVar(
+        "NARWHAL_LOOP_WATCHDOG_MS", "int", 0,
+        "Opt-in event-loop stall watchdog: >0 installs it with this "
+        "threshold (ms). Stalls land in the "
+        "`runtime.loop_stall_seconds` histogram with a stack excerpt in "
+        "`runtime.loop_stall_last`; 0/unset = off.",
+    ),
+    EnvVar(
+        "NARWHAL_FAULTHANDLER_S", "float", 0.0,
+        "Arm `faulthandler.dump_traceback_later` every N seconds "
+        "(C-level stack dumps that fire even with a wedged event loop); "
+        "0/unset = off.",
+    ),
+    EnvVar(
+        "NARWHAL_PROFILE", "str", None,
+        "cProfile the whole node, dumping stats into this directory on "
+        "SIGTERM.",
+    ),
+    # -- health-rule thresholds (metrics.default_rules) -----------------------
+    EnvVar(
+        "NARWHAL_HEALTH_MAX_COMMIT_LAG", "float", 20,
+        "`commit_lag` fires when `consensus.commit_lag_rounds` exceeds "
+        "this.",
+    ),
+    EnvVar(
+        "NARWHAL_HEALTH_COMMIT_STALL_S", "float", 10,
+        "`commit_stall` fires when rounds advance but no certificate "
+        "commits for this long.",
+    ),
+    EnvVar(
+        "NARWHAL_HEALTH_PENDING_ACK_FLOOR", "float", 512,
+        "`pending_acks` floor: backlog below this never fires.",
+    ),
+    EnvVar(
+        "NARWHAL_HEALTH_PENDING_ACK_WINDOW_S", "float", 5,
+        "`pending_acks` growth-rate window in seconds.",
+    ),
+    EnvVar(
+        "NARWHAL_HEALTH_PEER_RETRANS_RATE", "float", 10,
+        "`peer_retransmissions` fires above this many retransmits/s to "
+        "one peer.",
+    ),
+    EnvVar(
+        "NARWHAL_HEALTH_PEER_RETRANS_WINDOW_S", "float", 5,
+        "`peer_retransmissions` rate window in seconds.",
+    ),
+    EnvVar(
+        "NARWHAL_HEALTH_PEER_FAILURES", "float", 3,
+        "`peer_unreachable` fires at this many consecutive connect "
+        "failures against one peer (boot-grace gated).",
+    ),
+    EnvVar(
+        "NARWHAL_HEALTH_QUORUM_WEDGE_S", "float", 10,
+        "`quorum_wedge` fires when a sealed batch waits on its ACK "
+        "quorum this long.",
+    ),
+    EnvVar(
+        "NARWHAL_HEALTH_VOTE_SILENCE_WINDOW_S", "float", 8,
+        "`peer_vote_silence` observation window in seconds.",
+    ),
+    EnvVar(
+        "NARWHAL_HEALTH_VOTE_SILENCE_MIN_ROUNDS", "float", 3,
+        "`peer_vote_silence` requires at least this much round progress "
+        "inside the window.",
+    ),
+    EnvVar(
+        "NARWHAL_HEALTH_STALE_RATE", "float", 6,
+        "`stale_replay` fires above this many stale messages/s — sits "
+        "~2× above the measured partition-heal catch-up burst "
+        "(2.4-2.9/s) and under the 10/s replay-flood attack.",
+    ),
+    EnvVar(
+        "NARWHAL_HEALTH_STALE_WINDOW_S", "float", 5,
+        "`stale_replay` rate window in seconds.",
+    ),
+    EnvVar(
+        "NARWHAL_HEALTH_SYNC_AGE_S", "float", 8,
+        "`batch_withholding` fires when a requested-but-unserved batch "
+        "ages past this (above the stock 5 s sync retry delay).",
+    ),
+    # -- device plane ---------------------------------------------------------
+    EnvVar(
+        "NARWHAL_FIELD_DTYPE", "str", "int32",
+        "Lane dtype of `ops/field25519` (`int32` or `float32`); read at "
+        "import.",
+    ),
+    EnvVar(
+        "NARWHAL_TPU_WARMUP_SHAPES", "str", None,
+        "Extra comma-separated claim counts to pre-compile into the "
+        "verify kernel's warmup sweep.",
+    ),
+    EnvVar(
+        "NARWHAL_JAX_CACHE", "str", None,
+        "Persistent XLA compilation-cache directory shared across node "
+        "processes.",
+        shown_default="~/.cache/narwhal_tpu_jax",
+    ),
+    # -- fault injection ------------------------------------------------------
+    EnvVar(
+        "NARWHAL_FAULT_PLAN", "str", None,
+        "Path to a Byzantine plan JSON (equivalent of `node run "
+        "--fault-plan`); makes the node ATTACK its committee.",
+    ),
+    EnvVar(
+        "NARWHAL_FAULT_SEED", "int", None,
+        "Overrides the fault plan's RNG seed (rogue keys, twin minting, "
+        "fuzz draws).",
+    ),
+    EnvVar(
+        "NARWHAL_FAULT_NETEM", "str", None,
+        "Path to a WAN-emulation spec consumed by `faults/netem.py`; "
+        "unset = no emulation.",
+    ),
+    EnvVar(
+        "NARWHAL_FAULT_NODE", "str", "",
+        "This node's name in the netem spec (selects its link profile).",
+    ),
+]
+
+REGISTRY: Dict[str, EnvVar] = {v.name: v for v in _VARS}
+assert len(REGISTRY) == len(_VARS), "duplicate EnvVar declaration"
+
+
+def declared(name: str) -> EnvVar:
+    """The declaration for ``name``; raises (the runtime half of the
+    ``env-var-registry`` lint rule) on an undeclared knob."""
+    try:
+        return REGISTRY[name]
+    except KeyError:
+        raise KeyError(
+            f"{name} is not declared in narwhal_tpu/utils/env.py REGISTRY "
+            "— declare it (name, type, default, doc) before reading it"
+        ) from None
+
+
+def env_raw(
+    name: str, env: Optional[Mapping[str, str]] = None
+) -> Optional[str]:
+    """The raw string value (or None), with the declaration check.
+    ``env`` overrides ``os.environ`` for injectable call sites."""
+    declared(name)
+    return (os.environ if env is None else env).get(name)
+
+
+_FALSE = {"", "0", "false", "no", "off"}
+
+
+def env_flag(
+    name: str,
+    default: object = _UNSET,
+    env: Optional[Mapping[str, str]] = None,
+) -> bool:
+    raw = env_raw(name, env)
+    if raw is None:
+        d = REGISTRY[name].default if default is _UNSET else default
+        return bool(d)
+    return raw.strip().lower() not in _FALSE
+
+
+def env_str(
+    name: str,
+    default: object = _UNSET,
+    env: Optional[Mapping[str, str]] = None,
+):
+    raw = env_raw(name, env)
+    if raw is not None:
+        return raw
+    return REGISTRY[name].default if default is _UNSET else default
+
+
+@functools.lru_cache(maxsize=128)
+def _parse_number(name: str, raw: str, caster, fallback) -> object:
+    # Memoized per raw value: misconfiguration must warn once, not at
+    # call-site frequency.
+    try:
+        return caster(raw)
+    except (TypeError, ValueError):
+        log.warning(
+            "%s=%r is not a valid %s; using %r",
+            name, raw, caster.__name__, fallback,
+        )
+        return fallback
+
+
+def env_int(
+    name: str,
+    default: object = _UNSET,
+    env: Optional[Mapping[str, str]] = None,
+):
+    raw = env_raw(name, env)
+    d = REGISTRY[name].default if default is _UNSET else default
+    if raw is None:
+        return d
+    if not isinstance(raw, str):  # injected mapping may carry parsed values
+        return int(raw)
+    return _parse_number(name, raw, int, d)
+
+
+def env_float(
+    name: str,
+    default: object = _UNSET,
+    env: Optional[Mapping[str, str]] = None,
+):
+    raw = env_raw(name, env)
+    d = REGISTRY[name].default if default is _UNSET else default
+    if raw is None:
+        return d
+    if not isinstance(raw, str):
+        return float(raw)
+    return _parse_number(name, raw, float, d)
 
 
 @functools.lru_cache(maxsize=64)
@@ -34,8 +362,30 @@ def _parse_positive_int(name: str, raw: str, default: int) -> int:
 
 def positive_int(name: str, default: int) -> int:
     """``int(os.environ[name])`` when set and positive, else ``default``
-    (with a once-per-value warning on garbage)."""
-    raw = os.environ.get(name)
+    (with a once-per-value warning on garbage).  The default stays at the
+    call site because these knobs compute it (e.g. from batch_size)."""
+    raw = env_raw(name)
     if raw is None:
         return default
     return _parse_positive_int(name, raw, default)
+
+
+# -- README table -------------------------------------------------------------
+
+TABLE_BEGIN = "<!-- env-table:begin (generated: python -m narwhal_tpu.analysis --env-table) -->"
+TABLE_END = "<!-- env-table:end -->"
+
+
+def render_table() -> str:
+    """The README 'Environment variables' markdown table, generated from
+    the registry so the doc and the code cannot drift (the linter
+    compares this output against the README section)."""
+    lines = [
+        "| Variable | Type | Default | Meaning |",
+        "|---|---|---|---|",
+    ]
+    for v in sorted(REGISTRY.values(), key=lambda v: v.name):
+        lines.append(
+            f"| `{v.name}` | {v.kind} | {v.rendered_default} | {v.doc} |"
+        )
+    return "\n".join(lines)

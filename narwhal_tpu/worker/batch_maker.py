@@ -32,6 +32,7 @@ from ..config import Committee, WorkerId
 from ..crypto import PublicKey, digest32
 from ..network import ReliableSender
 from ..network.framing import parse_address
+from ..utils.tasks import spawn
 
 log = logging.getLogger("narwhal.worker")
 
@@ -231,8 +232,8 @@ class BatchMaker:
                 for p in self._protocols:
                     if p.transport is not None:
                         p.transport.pause_reading()
-                self._drain_task = self._loop.create_task(
-                    self._drain_overflow()
+                self._drain_task = spawn(
+                    self._drain_overflow(), name="batch-maker-drain"
                 )
 
     def _broadcast_batch(self, digest, message: bytes):

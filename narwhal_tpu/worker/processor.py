@@ -11,15 +11,15 @@ from __future__ import annotations
 
 import asyncio
 import logging
-import os
 
 from .. import metrics
 from ..config import WorkerId
 from ..crypto import digest32
 from ..messages import encode_batch_digest
+from ..utils.env import env_flag
 
 log = logging.getLogger("narwhal.worker")
-_TRACE = bool(os.environ.get("NARWHAL_TRACE"))
+_TRACE = env_flag("NARWHAL_TRACE")
 
 
 class Processor:

@@ -10,16 +10,16 @@ from __future__ import annotations
 
 import asyncio
 import logging
-import os
 import time
 from typing import Optional
 
 from .. import metrics
 from ..config import Committee
 from ..crypto import PublicKey
+from ..utils.env import env_flag
 
 log = logging.getLogger("narwhal.worker")
-_TRACE = bool(os.environ.get("NARWHAL_TRACE"))
+_TRACE = env_flag("NARWHAL_TRACE")
 
 
 class QuorumWaiter:

@@ -40,6 +40,7 @@ from ..messages import Round, encode_batch_request
 from ..network import SimpleSender
 from ..network.reliable_sender import next_backoff
 from .helper import max_request_digests
+from ..utils.tasks import spawn
 
 log = logging.getLogger("narwhal.worker")
 
@@ -108,7 +109,7 @@ class Synchronizer:
         _SYNCHRONIZERS.add(self)
 
     async def run(self) -> None:
-        timer = asyncio.get_running_loop().create_task(self._timer())
+        timer = spawn(self._timer(), name="worker-sync-timer")
         try:
             while True:
                 cmd = await self.in_queue.get()
@@ -155,9 +156,7 @@ class Synchronizer:
             )
             # Clear pending as soon as the batch lands in the store
             # (the Processor writes it when the Helper's reply arrives).
-            self._waiters[digest] = asyncio.get_running_loop().create_task(
-                self._await_arrival(digest)
-            )
+            self._waiters[digest] = spawn(self._await_arrival(digest))
         if not missing:
             return
         self._m_requested.inc(len(missing))

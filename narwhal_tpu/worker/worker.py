@@ -27,7 +27,8 @@ from ..messages import (
 )
 from ..network import Receiver, Writer
 from ..store import Store
-from ..utils.env import positive_int
+from ..utils.env import env_flag, positive_int
+from ..utils.tasks import spawn
 from .batch_maker import BatchMaker
 from .helper import Helper, max_request_digests
 from .primary_connector import PrimaryConnector
@@ -238,15 +239,14 @@ class Worker:
         # Queue-depth gauges: callbacks polled only at snapshot/scrape
         # time, so the hot path pays nothing.  These are exactly the
         # depths the NARWHAL_TRACE heartbeat used to log — now first-class.
-        for gname, gq in (
-            ("worker.queue.to_quorum", to_quorum),
-            ("worker.queue.own_batches", own_batches),
-            ("worker.queue.others_batches", others_batches),
-            ("worker.queue.to_primary", to_primary),
-            ("worker.queue.helper", helper_queue),
-            ("worker.queue.sync", sync_queue),
-        ):
-            metrics.gauge_fn(gname, gq.qsize)
+        # One literal call per name (no loop) so the metric-name-drift
+        # lint rule can see every registered name statically.
+        metrics.gauge_fn("worker.queue.to_quorum", to_quorum.qsize)
+        metrics.gauge_fn("worker.queue.own_batches", own_batches.qsize)
+        metrics.gauge_fn("worker.queue.others_batches", others_batches.qsize)
+        metrics.gauge_fn("worker.queue.to_primary", to_primary.qsize)
+        metrics.gauge_fn("worker.queue.helper", helper_queue.qsize)
+        metrics.gauge_fn("worker.queue.sync", sync_queue.qsize)
 
         addrs = committee.worker(name, worker_id)
         primary_addr = committee.primary(name).worker_to_primary
@@ -321,7 +321,9 @@ class Worker:
             runners.append(flooder)
             self.senders.append(flooder.sender)
         for runner in runners:
-            self.tasks.append(loop.create_task(runner.run()))
+            self.tasks.append(
+                spawn(runner.run(), name=type(runner).__name__.lower())
+            )
         # The tx socket is bound inside BatchMaker.run; wait so clients can
         # connect as soon as spawn returns, and fail fast on a bind error.
         await batch_maker.started.wait()
@@ -329,9 +331,7 @@ class Worker:
             await self.shutdown()
             raise batch_maker.boot_error
 
-        import os as _os
-
-        if _os.environ.get("NARWHAL_TRACE"):
+        if env_flag("NARWHAL_TRACE"):
             async def heartbeat():
                 while True:
                     t0 = loop.time()
@@ -350,7 +350,7 @@ class Worker:
                         batch_maker.batcher.tx_bytes,
                     )
 
-            self.tasks.append(loop.create_task(heartbeat()))
+            self.tasks.append(spawn(heartbeat(), name="trace-heartbeat"))
 
         log.info(
             "Worker %d successfully booted on %s",

@@ -239,13 +239,23 @@ def test_restarted_node_rejoins_and_commits(run, tmp_path):
 
         # Crash node 3 and restart it from its persisted stores.  The
         # consensus frontier checkpoint must already be on disk — that is
-        # what the reboot below restores.
-        for node in nodes[3]:
-            await node.shutdown()
+        # what the reboot below restores.  The checkpoint rewrite runs in
+        # an executor AFTER the commit is delivered downstream (which is
+        # what committed_everywhere observed), so on a starved host the
+        # file can trail the commit by a beat — wait for it BEFORE the
+        # crash rather than racing the shutdown's task cancellation.
         import os as _os
 
+        ckpt = f"{tmp_path}/primary-3/store.log.consensus.ckpt"
+        for _ in range(100):
+            if _os.path.exists(ckpt):
+                break
+            await asyncio.sleep(0.1)
+        for node in nodes[3]:
+            await node.shutdown()
+
         assert _os.path.exists(
-            f"{tmp_path}/primary-3/store.log.consensus.ckpt"
+            ckpt
         ), "consensus checkpoint never written before the crash"
         nodes[3] = await boot(3, kps[3])
 

@@ -57,6 +57,10 @@ def test_spec_parses_and_env_seed_overrides():
     assert s.honest_nodes() == [1, 2, 3]
     s2 = parse_scenario(_minimal(seed=5), env={"NARWHAL_FAULT_SEED": "99"})
     assert s2.seed == 99
+    # A malformed override must fail LOUD, not silently fall back to the
+    # spec's own seed: the operator asked to replay a specific draw.
+    with pytest.raises(SpecError):
+        parse_scenario(_minimal(seed=5), env={"NARWHAL_FAULT_SEED": "0x2A"})
 
 
 def test_spec_rejects_unknown_fields_and_behaviors():

@@ -34,6 +34,11 @@ def _run_clean_bench(tmp_path):
             workdir=str(tmp_path / f"bench-{attempt}"),
             quiet=True,
             scrape_interval=1.0,
+            # The ISSUE 9 loop-watchdog smoke arm: every node arms the
+            # event-loop stall watchdog so a clean run MEASURES (not
+            # infers) that no callback held its loop — the series lands
+            # in the bench JSON `runtime` section, asserted below.
+            loop_watchdog_ms=100,
             # Widen the window on wall-clock payload-commit progress: on
             # a starved core the clients can ramp so late that a fixed
             # 8 s window closes before the first client batch commits.
@@ -143,6 +148,18 @@ def test_clean_local_bench_has_timeline_and_no_firing_rules(tmp_path):
     # exceed what went on the wire.
     assert 0 < wire["goodput_ratio"] < 1, wire
     assert 0 < wire["cert_sig_bytes_fraction"] < 1, wire
+
+    # -- loop-stall watchdog smoke arm (ISSUE 9 acceptance) ------------------
+    # Every node ran with NARWHAL_LOOP_WATCHDOG_MS=100, so every
+    # post-mortem snapshot must carry the runtime.loop_stall_seconds
+    # series (count may be 0 — "watchdog ran, saw no stall" is the
+    # measurement; a missing series means the watchdog never armed).
+    runtime = result.runtime
+    assert len(runtime) == 8, sorted(runtime)
+    for node, r in runtime.items():
+        assert "count" in r["loop_stall_seconds"], (node, r)
+        assert r["loop_stall_seconds"]["count"] >= 0
+        assert r["stalls"] >= 0
 
     # -- crypto-cost ledger (ISSUE 7 acceptance) -----------------------------
     crypto = result.crypto

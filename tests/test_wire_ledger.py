@@ -92,7 +92,7 @@ def test_sender_receiver_totals_reconcile_per_type():
     type (loopback, no loss)."""
 
     async def go():
-        addr = "127.0.0.1:16310"
+        addr = "127.0.0.1:12310"
         handler = RecordingAckHandler()
         recv = await Receiver.spawn(
             addr, handler, classify=frame_classifier(PRIMARY_FRAME_TYPES)
@@ -131,7 +131,7 @@ def test_sender_receiver_totals_reconcile_per_type():
 
 def test_simple_sender_typed_accounting():
     async def go():
-        addr = "127.0.0.1:16320"
+        addr = "127.0.0.1:12320"
         handler = RecordingAckHandler()
         recv = await Receiver.spawn(
             addr, handler,
@@ -163,7 +163,7 @@ def test_retransmitted_bytes_land_in_retransmit_counter():
     never inflated by the retry."""
 
     async def go():
-        port = 16330
+        port = 12330
         addr = f"127.0.0.1:{port}"
         data = bytes([0]) + b"h" * 199  # "header"
 
@@ -224,7 +224,7 @@ def test_netem_loss_reconciles_within_retransmit_accounting():
     total is bounded by sent-plus-retransmitted."""
 
     async def go():
-        addr = "127.0.0.1:16340"
+        addr = "127.0.0.1:12340"
         n_msgs, size = 8, 150
         handler = RecordingAckHandler()
         recv = await Receiver.spawn(

@@ -38,7 +38,10 @@ from tests.common import committee, keys  # noqa: E402
 from tests.test_worker_hardening import FakeSender, _counter  # noqa: E402
 
 
-def _maker(plan, base_port=17000):
+# Committee ports live BELOW this host's ephemeral range (ip_local_port_range
+# starts at 16000 here): a listener in that range races the OS's outgoing
+# source ports and flakes EADDRINUSE in full-suite runs.
+def _maker(plan, base_port=12000):
     c = committee(base_port=base_port)
     me = keys()[0].name
     maker = ByzantineBatchMaker(
@@ -94,7 +97,7 @@ def test_honest_behaviors_broadcast_to_everyone():
 
 def test_withhold_requires_unit_stake():
     async def go():
-        c = committee(base_port=17030)
+        c = committee(base_port=12030)
         next(iter(c.authorities.values())).stake = 5
         me = keys()[0].name
         with pytest.raises(SpecError):
@@ -111,7 +114,7 @@ def test_withhold_requires_unit_stake():
 
 def test_withholding_helper_never_serves():
     async def go():
-        c = committee(base_port=17060)
+        c = committee(base_port=12060)
         store = Store()
         data = encode_batch([bytes(40)])
         store.write(bytes(digest32(data)), data)
@@ -133,7 +136,7 @@ def test_garbage_helper_serves_oversized_and_corrupt_junk():
     (caught by the structural walk) — never the real bytes."""
 
     async def go():
-        c = committee(base_port=17090)
+        c = committee(base_port=12090)
         store = Store()
         data = encode_batch([bytes(40)])
         store.write(bytes(digest32(data)), data)
@@ -166,7 +169,7 @@ def test_garbage_reply_is_rejected_by_the_size_gate():
 
         helper = ByzantineHelper(
             ByzantinePlan(["garbage_batches"], garbage_bytes=800_000),
-            0, committee(base_port=17120), Store(), asyncio.Queue(),
+            0, committee(base_port=12120), Store(), asyncio.Queue(),
         )
         helper.sender = FakeSender()
         await helper._respond("addr", [Digest(bytes(32))])
@@ -190,7 +193,7 @@ def test_garbage_reply_is_rejected_by_the_size_gate():
 
 def test_flood_requests_exceed_cap_and_get_truncated():
     async def go():
-        c = committee(base_port=17150)
+        c = committee(base_port=12150)
         store = Store()
         data = encode_batch([bytes(40)])
         store.write(bytes(digest32(data)), data)
@@ -370,7 +373,7 @@ def test_withholding_worker_detected_and_committee_survives():
     gc.collect()  # drop earlier tests' synchronizers from the age gauge
 
     async def go():
-        c = committee(base_port=17200)
+        c = committee(base_port=12200)
         params = Parameters(
             header_size=32,
             max_header_delay=100,
