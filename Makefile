@@ -11,7 +11,8 @@
 PYTHON ?= python
 
 .PHONY: check native lint lint-invariants test test-ci metrics-smoke \
-	fault-smoke fault-fuzz-smoke trajectory bench clean
+	fault-smoke fault-fuzz-smoke trajectory race-explore sanitize \
+	bench clean
 
 check: native lint test
 
@@ -93,6 +94,36 @@ trajectory:
 	mkdir -p .ci-artifacts
 	$(PYTHON) benchmark/trajectory.py \
 		--report .ci-artifacts/trajectory.json
+
+# narwhal-race schedule explorer (ISSUE 10): 16 seeded schedules of the
+# reference pipeline scenario must commit byte-identically to the golden
+# walk (plus a same-seed reproducibility pin), the socketed 4-node
+# committee arm must pass its golden-replay + cross-node-prefix safety
+# verdicts per seed, and the planted RacyConsensus race must be caught
+# by BOTH the static interleave rule and a divergent schedule (the
+# non-vacuity gate).  Divergent seeds dump `*.repro-<seed>.json` repros
+# next to the artifact; replay one with
+# `python benchmark/race_explore.py --repro <seed> [--mutated]`.
+race-explore:
+	mkdir -p .ci-artifacts
+	JAX_PLATFORMS=cpu $(PYTHON) benchmark/race_explore.py \
+		--seeds 16 --committee-seeds 4 \
+		--artifact .ci-artifacts/race-explore.json
+
+# Asyncio sanitizer tier (ISSUE 10): the fast concurrency-sensitive
+# tier-1 subset under `python -X dev` — asyncio debug mode with the
+# slow-callback threshold aligned to the PR 9 watchdog default
+# (NARWHAL_LOOP_WATCHDOG_MS=100 arms it on node-booting tests, and
+# loop.slow_callback_duration follows it), plus ResourceWarning
+# escalated to an error: an unclosed socket/file surfacing at GC is a
+# task-teardown bug, not noise.
+sanitize:
+	JAX_PLATFORMS=cpu NARWHAL_LOOP_WATCHDOG_MS=100 \
+		$(PYTHON) -X dev -W error::ResourceWarning -m pytest \
+		tests/test_store.py tests/test_tasks.py \
+		tests/test_sync_timeouts.py \
+		tests/test_checkpoint_under_load.py tests/test_schedule.py \
+		tests/test_interleave.py -q
 
 # The crypto differential suite under the float32 lane dtype (the default
 # run covers int32 + a narrow f32 subprocess check; run this after any

@@ -7,6 +7,8 @@
 | wire-type-coverage | allow-wire-type | every sender call labels its frame; labels ⊆ classifier maps ⊆ labels |
 | metric-name-drift | allow-metric-name | every metric name a consumer references is actually emitted |
 | env-var-registry | allow-env | every NARWHAL_* literal is declared; reads route through utils/env.py; no dead declarations; README table fresh |
+| interleave-window | allow-interleave | no self-attr read→yield→write window on state another task root writes (interleave.py) |
+| interleave-iteration | allow-interleave | no direct iteration over shared state spanning a yield point (interleave.py) |
 
 Rules are pure functions ``Project -> Iterable[Finding]`` so the test
 suite can run them against in-memory mutations.  Suppression is per-node
@@ -27,6 +29,7 @@ PRAGMA_NAMES = (
     "wire-type",
     "metric-name",
     "env",
+    "interleave",
 )
 
 
@@ -637,10 +640,17 @@ def _env_table_drift(project: Project) -> List[Finding]:
     return []
 
 
+from .interleave import (  # noqa: E402  (bottom import: shares helpers)
+    rule_interleave_iteration,
+    rule_interleave_window,
+)
+
 ALL_RULES = (
     rule_no_blocking_in_async,
     rule_task_retention,
     rule_wire_type_coverage,
     rule_metric_name_drift,
     rule_env_var_registry,
+    rule_interleave_window,
+    rule_interleave_iteration,
 )

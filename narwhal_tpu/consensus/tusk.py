@@ -44,6 +44,7 @@ import asyncio
 import logging
 import os
 import struct
+import tempfile
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -422,6 +423,23 @@ class Tusk:
         return ordered
 
 
+def _sweep_checkpoint_tmps(checkpoint_path: str) -> None:
+    """Unlink `<basename>.tmp.*` leftovers beside the checkpoint (boot
+    only; see the call site in Consensus.__init__)."""
+    directory = os.path.dirname(checkpoint_path) or "."
+    prefix = os.path.basename(checkpoint_path) + ".tmp."
+    try:
+        entries = os.listdir(directory)
+    except OSError:
+        return  # directory missing: the writer will report it per burst
+    for name in entries:
+        if name.startswith(prefix):
+            try:
+                os.unlink(os.path.join(directory, name))
+            except OSError:
+                pass
+
+
 class Consensus:
     """Async runner: certificates in from the primary, ordered certificates
     out to the application and back to the primary for GC."""
@@ -489,6 +507,17 @@ class Consensus:
         # satisfies dependency checks without replay — so the checkpoint
         # is the backstop for the paths where it does.)
         self.checkpoint_path = checkpoint_path
+        if checkpoint_path is not None:
+            # Sweep tmp files stranded by a crash between mkstemp and
+            # os.replace (unique names are what make concurrent writers
+            # safe, but uniqueness also means nothing reuses a stranded
+            # one — without this, a crash-looping node grows one stale
+            # tmp per incarnation forever).  Only OUR basename's tmps;
+            # a concurrently-running sibling instance would have to be
+            # mid-write on the same path to lose one, which the unique
+            # names exist to make harmless anyway (it retries next
+            # burst).
+            _sweep_checkpoint_tmps(checkpoint_path)
         restored_blob = b""
         if checkpoint_path is not None and os.path.exists(checkpoint_path):
             try:
@@ -628,18 +657,55 @@ class Consensus:
                 # (it is an optimization; at worst one more burst
                 # re-delivers).
                 blob = self.tusk.state.snapshot_bytes()
-                await asyncio.get_running_loop().run_in_executor(
-                    None, self._write_checkpoint, blob
-                )
+                try:
+                    await asyncio.get_running_loop().run_in_executor(
+                        None, self._write_checkpoint, blob
+                    )
+                except OSError:
+                    # The checkpoint is a recovery OPTIMIZATION: a failed
+                    # rewrite (ENOSPC clearing, a tmp-dir hiccup, a
+                    # racing writer) costs one burst of at-least-once
+                    # re-delivery on the next restart — an unhandled
+                    # exception here killed the ENTIRE commit pipeline
+                    # instead, silently wedging the node while certs
+                    # kept queueing.  Found by the narwhal-race
+                    # deterministic harness (ISSUE 10): a restart
+                    # overlap made the pre-crash incarnation's in-flight
+                    # executor write race this one's and the loser's
+                    # os.replace raised FileNotFoundError straight into
+                    # Consensus.run.
+                    log.exception(
+                        "consensus checkpoint rewrite to %s failed; "
+                        "continuing without it (next burst retries)",
+                        self.checkpoint_path,
+                    )
 
     def _write_checkpoint(self, blob: bytes) -> None:
-        tmp = self.checkpoint_path + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(blob)
-            # fsync BEFORE the rename: os.replace is atomic against
-            # process crash, but on power loss the rename can become
-            # durable before the data, leaving a torn file under the
-            # final name (ADVICE.md r05).
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self.checkpoint_path)
+        # Unique tmp per write (NOT a fixed `<path>.tmp`): two writers
+        # sharing one checkpoint path — an in-process restart whose
+        # previous incarnation's executor write is still in flight, or
+        # two instances pointed at one file — would open the same tmp
+        # and the loser's os.replace would find it already renamed away.
+        # With unique tmps, concurrent writers are safe: os.replace is
+        # atomic, last-completed-writer wins, and the file under the
+        # final name is always a complete snapshot.
+        fd, tmp = tempfile.mkstemp(
+            dir=os.path.dirname(self.checkpoint_path) or ".",
+            prefix=os.path.basename(self.checkpoint_path) + ".tmp.",
+        )
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(blob)
+                # fsync BEFORE the rename: os.replace is atomic against
+                # process crash, but on power loss the rename can become
+                # durable before the data, leaving a torn file under the
+                # final name (ADVICE.md r05).
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.checkpoint_path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise

@@ -206,6 +206,7 @@ class Proposer:
                 if core_get is not None and core_get in done:
                     parents, round = core_get.result()
                     core_get = loop.create_task(self.rx_core.get())
+                    # lint: allow-interleave(round/last_parents ARE written mid-mint by Core's synchronous deliver_parents callback while _make_header awaits Header.new — safely: _advance only ever replaces last_parents with a NEWER quorum and bumps round monotonically, _make_header consumed the previous quorum into locals before its first yield, and every loop iteration re-reads both fresh before the next mint decision)
                     self._advance(parents, round)
                 if workers_get in done:
                     digest, worker_id = workers_get.result()

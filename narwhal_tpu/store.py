@@ -219,6 +219,7 @@ class Store:
         if val is not None:
             return val
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        # lint: allow-interleave(every store-sharing task root can append to _obligations while this waiter is suspended on its future — safely: _deliver pops a key's WHOLE waiter list atomically before resolving any future, and the cancelled-waiter cleanup below removes only the future THIS call appended, re-reading the dict after the suspension)
         self._obligations.setdefault(key, []).append(fut)
         try:
             return await fut

@@ -146,7 +146,9 @@ class BatchMaker:
             # seals every max_batch_delay (reference batch_maker.rs:71-98
             # uses an interval timer for the same reason).
             while True:
+                # lint: allow-interleave(_dirty/_deadline are rewritten by the client-socket data_received callbacks (size-seal path) while this loop sleeps — safely: every suspension is followed by a `continue` that re-reads both before acting, and the deadline-expired _seal below runs synchronously from a post-suspension read, so a size-seal can only ever cause one spurious re-check, never a stale seal)
                 await self._dirty.wait()
+                # lint: allow-interleave(same re-read discipline as the wait above: the sleep is followed by a `continue`, never by acting on the pre-sleep deadline)
                 deadline = self._deadline
                 if deadline is None:  # sealed by size meanwhile
                     self._dirty.clear()

@@ -1,0 +1,316 @@
+"""Interleave-rule acceptance suite (ISSUE 10, static half).
+
+Same three layers as tests/test_analysis.py: fixture snippets prove each
+rule shape fires (and each sanctioned shape passes), the live tree is
+clean, and seeded mutations against REAL files prove the rules are alive
+on the tree they guard — including stripping the live pragmas, which
+must resurface the windows they document.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from narwhal_tpu.analysis import run_lint  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE = "narwhal_tpu/_interleave_fixture.py"
+
+
+def fixture_findings(source, rule=None, path=FIXTURE):
+    findings = [
+        f for f in run_lint(REPO, overlay={path: source}) if f.path == path
+    ]
+    if rule is not None:
+        findings = [f for f in findings if f.rule == rule]
+    return findings
+
+
+# -- interleave-window: must flag ---------------------------------------------
+
+WINDOW_FLAGGED = '''
+import asyncio
+
+from ..utils.tasks import spawn
+
+
+class Fixture:
+    def __init__(self, queue: asyncio.Queue):
+        self.queue = queue
+        self.backlog = []
+
+    async def run(self):
+        while True:
+            await self.queue.get()
+            spawn(self._drain())
+
+    async def _drain(self):
+        staged = self.backlog
+        for item in list(staged):
+            await self.queue.put(item)
+        self.backlog = []
+'''
+
+
+def test_window_rule_flags_spawned_in_loop_race():
+    found = fixture_findings(WINDOW_FLAGGED, "interleave-window")
+    assert len(found) == 1, found
+    msg = found[0].message
+    assert "self.backlog" in msg
+    assert "multi-instance" in msg  # spawned from inside a loop
+    assert "torn-invariant window" in msg
+
+
+def test_window_finding_reports_the_yield_chain():
+    # The suspension is reported as the actual await, not just a line.
+    found = fixture_findings(WINDOW_FLAGGED, "interleave-window")
+    assert "await self.queue.put" in found[0].message
+
+
+WINDOW_CROSS_ROOT = '''
+import asyncio
+
+
+class FixtureScribe:
+    def __init__(self, state: "FixtureShared"):
+        self.state = state
+
+    async def run(self):
+        while True:
+            await asyncio.sleep(1)
+            self.state.slots["k"] = 1
+
+
+class FixtureShared:
+    def __init__(self, queue: asyncio.Queue):
+        self.queue = queue
+        self.slots = {}
+
+    async def run(self):
+        while True:
+            probe = self.slots.get("k")
+            await self.queue.get()
+            self.slots["k"] = probe
+'''
+
+
+def test_window_rule_sees_cross_class_sharing_through_typed_attrs():
+    found = fixture_findings(WINDOW_CROSS_ROOT, "interleave-window")
+    assert len(found) == 1, found
+    assert "self.slots" in found[0].message
+    # Names the OTHER task root that writes through the typed attribute.
+    assert "FixtureScribe.run" in found[0].message
+
+
+# -- interleave-window: must pass ---------------------------------------------
+
+WINDOW_CLEAN = '''
+import asyncio
+
+from ..utils.tasks import spawn
+
+
+class SingleRoot:
+    """Read→yield→write, but only ONE task ever touches the attr."""
+
+    def __init__(self, queue: asyncio.Queue):
+        self.queue = queue
+        self.backlog = []
+
+    async def run(self):
+        while True:
+            staged = self.backlog
+            await self.queue.get()
+            self.backlog = staged
+
+
+class TakeBeforeYield:
+    """The sanctioned shape: consume shared state atomically BEFORE the
+    suspension; another task may refill it meanwhile."""
+
+    def __init__(self, queue: asyncio.Queue):
+        self.queue = queue
+        self.backlog = []
+
+    def push(self, item):
+        self.backlog.append(item)
+
+    async def run(self):
+        while True:
+            staged, self.backlog = self.backlog, []
+            for item in staged:
+                await self.queue.put(item)
+
+
+class AtomicTick:
+    """Sleep-then-atomic-tick: every read/write happens after the yield,
+    within one uninterrupted slice (the timer pattern all waiters use)."""
+
+    def __init__(self, peer: TakeBeforeYield):
+        self.peer = peer
+        self.pending = {}
+
+    def note(self, k, v):
+        self.pending[k] = v
+
+    async def run(self):
+        while True:
+            await asyncio.sleep(1.0)
+            for k in [k for k in self.pending if k < 0]:
+                del self.pending[k]
+            self.peer.push(len(self.pending))
+'''
+
+
+def test_window_rule_passes_single_root_take_and_tick_shapes():
+    assert fixture_findings(WINDOW_CLEAN, "interleave-window") == []
+    assert fixture_findings(WINDOW_CLEAN, "interleave-iteration") == []
+
+
+NONYIELDING_AWAIT = '''
+import asyncio
+
+from ..utils.tasks import spawn
+
+
+class Handlers:
+    """Awaiting an async helper that never suspends is NOT a yield point
+    (asyncio runs it to completion synchronously) — the HeaderWaiter's
+    atomic-tick handlers depend on exactly this."""
+
+    def __init__(self, queue: asyncio.Queue):
+        self.queue = queue
+        self.pending = {}
+
+    async def run(self):
+        spawn(self._other())
+        while True:
+            probe = len(self.pending)
+            await self._handle(probe)
+            self.pending[probe] = True
+
+    async def _handle(self, probe):
+        self.pending.setdefault(probe, False)
+
+    async def _other(self):
+        while True:
+            await asyncio.sleep(1.0)
+            self.pending.clear()
+'''
+
+
+def test_awaiting_a_nonyielding_helper_is_not_a_window():
+    assert fixture_findings(NONYIELDING_AWAIT, "interleave-window") == []
+
+
+# -- interleave-iteration ------------------------------------------------------
+
+ITER_FLAGGED = '''
+import asyncio
+
+from ..utils.tasks import spawn
+
+
+class Fixture:
+    def __init__(self, queue: asyncio.Queue):
+        self.queue = queue
+        self.waiting = {}
+
+    async def run(self):
+        while True:
+            await self.queue.get()
+            spawn(self._flush())
+
+    async def _flush(self):
+        for digest, item in self.waiting.items():
+            await self.queue.put(item)
+        self.waiting.clear()
+'''
+
+ITER_CLEAN = ITER_FLAGGED.replace(
+    "self.waiting.items()", "list(self.waiting.items())"
+)
+
+
+def test_iteration_rule_flags_aliased_iteration_spanning_yield():
+    found = fixture_findings(ITER_FLAGGED, "interleave-iteration")
+    assert len(found) == 1, found
+    assert "self.waiting" in found[0].message
+    assert "mid-iteration" in found[0].message
+
+
+def test_iteration_rule_passes_list_snapshots():
+    assert fixture_findings(ITER_CLEAN, "interleave-iteration") == []
+
+
+# -- pragma semantics ----------------------------------------------------------
+
+def test_pragma_with_reason_suppresses_window():
+    src = WINDOW_FLAGGED.replace(
+        "        staged = self.backlog",
+        "        # lint: allow-interleave(fixture: each drain task owns "
+        "its snapshot)\n        staged = self.backlog",
+    )
+    assert fixture_findings(src, "interleave-window") == []
+
+
+def test_pragma_without_reason_does_not_suppress():
+    src = WINDOW_FLAGGED.replace(
+        "        staged = self.backlog",
+        "        staged = self.backlog  # lint: allow-interleave()",
+    )
+    found = fixture_findings(src)
+    rules = {f.rule for f in found}
+    assert "interleave-window" in rules and "pragma" in rules
+
+
+# -- live tree -----------------------------------------------------------------
+
+def test_live_tree_is_clean_under_interleave_rules():
+    findings = [
+        f for f in run_lint(REPO) if f.rule.startswith("interleave")
+    ]
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def _strip_pragma(path):
+    with open(os.path.join(REPO, path), encoding="utf-8") as f:
+        src = f.read()
+    out = "\n".join(
+        line for line in src.splitlines()
+        if "lint: allow-interleave(" not in line
+    )
+    assert out != src, f"no interleave pragma found in {path}"
+    return {path: out}
+
+
+def test_stripping_proposer_pragma_resurfaces_the_window():
+    overlay = _strip_pragma("narwhal_tpu/primary/proposer.py")
+    found = [
+        f for f in run_lint(REPO, overlay=overlay)
+        if f.rule == "interleave-window"
+        and f.path == "narwhal_tpu/primary/proposer.py"
+    ]
+    assert found, "the documented Proposer window is no longer detected"
+    assert any("deliver_parents" in f.message for f in found)
+
+
+def test_stripping_store_pragma_resurfaces_the_window():
+    overlay = _strip_pragma("narwhal_tpu/store.py")
+    found = [
+        f for f in run_lint(REPO, overlay=overlay)
+        if f.rule == "interleave-window" and f.path == "narwhal_tpu/store.py"
+    ]
+    assert found and any("_obligations" in f.message for f in found)
+
+
+def test_mutation_racy_consensus_is_flagged():
+    # The SAME overlay the race-explore mutation arm lints: one source of
+    # truth between the static catch here and the dynamic catch in
+    # benchmark/race_explore.py.
+    from benchmark.race_explore import static_mutation_findings
+
+    findings = static_mutation_findings()
+    assert findings, "planted RacyConsensus race not flagged"
+    assert any("_committing" in f for f in findings)

@@ -1,0 +1,143 @@
+"""ExploringEventLoop + race-explore acceptance suite (ISSUE 10, dynamic
+half).
+
+- the loop permutes same-tick task wakeups deterministically from its
+  seed (same seed → same order, different seeds → different orders);
+- non-task callbacks keep their FIFO order (asyncio's internal plumbing
+  relies on it — the sock_connect/_sock_write_done contract);
+- the clean pipeline scenario commits byte-identically to the golden
+  walk under every seed, and a seed is reproducible end-to-end;
+- the planted RacyConsensus race DIVERGES under a known seed before the
+  fix shape (the mutation) and the clean Consensus passes under the SAME
+  seed — the seed-pinned regression pattern the triage satellite asks
+  for, with the found-race as its subject.
+"""
+
+import asyncio
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from narwhal_tpu.analysis.schedule import (  # noqa: E402
+    ExploringEventLoop,
+    run_with_seed,
+)
+
+
+def _order_probe(n_tasks: int = 6, rounds: int = 5):
+    """N tasks that each append their id per round, with a yield between
+    appends: the final order is a pure function of the schedule."""
+
+    async def main():
+        out = []
+        gate = asyncio.Event()
+
+        async def worker(i):
+            await gate.wait()
+            for _ in range(rounds):
+                out.append(i)
+                await asyncio.sleep(0)
+
+        tasks = [
+            asyncio.get_running_loop().create_task(worker(i))
+            for i in range(n_tasks)
+        ]
+        gate.set()  # all workers become runnable in the same tick
+        await asyncio.gather(*tasks)
+        return tuple(out)
+
+    return main
+
+
+def test_same_seed_same_schedule():
+    a, stats_a = run_with_seed(_order_probe(), seed=7, timeout=30)
+    b, stats_b = run_with_seed(_order_probe(), seed=7, timeout=30)
+    assert a == b
+    assert stats_a["permutations"] > 0, "probe explored nothing"
+
+
+def test_different_seeds_explore_different_schedules():
+    orders = {
+        run_with_seed(_order_probe(), seed=s, timeout=30)[0]
+        for s in range(8)
+    }
+    assert len(orders) > 1, "eight seeds produced one schedule"
+
+
+def test_plain_callbacks_keep_fifo_order():
+    """call_soon callbacks (non-task) must NEVER be reordered, whatever
+    the seed — asyncio's internals depend on their FIFO contract."""
+    for seed in range(5):
+        async def main():
+            out = []
+            loop = asyncio.get_running_loop()
+            done = asyncio.Event()
+            for i in range(10):
+                loop.call_soon(out.append, i)
+            loop.call_soon(done.set)
+            await done.wait()
+            return out
+
+        out, _ = run_with_seed(main, seed=seed, timeout=30)
+        assert out == list(range(10)), (seed, out)
+
+
+def test_stats_and_loop_attributes():
+    loop = ExploringEventLoop(seed=3)
+    try:
+        assert loop.seed == 3 and loop.permutations == 0
+    finally:
+        loop.close()
+
+
+# -- pipeline scenario: the seed-pinned regression pair -----------------------
+
+PINNED_SEED = 1000  # the seed race_explore's mutation arm diverges at
+
+
+def test_clean_pipeline_is_byte_identical_under_pinned_seed(tmp_path):
+    from benchmark.race_explore import run_pipeline_seed
+
+    report = run_pipeline_seed(PINNED_SEED, str(tmp_path))
+    assert report["ok"], report
+    assert report["identical_to_golden"] and report["audit_replay_ok"]
+    assert report["schedule"]["permutations"] >= 10, (
+        "the reference scenario has gone vacuous"
+    )
+
+
+def test_planted_race_diverges_under_pinned_seed_and_is_reproducible(
+    tmp_path,
+):
+    """The regression pair: the mutated (pre-fix) shape diverges under
+    this exact seed; the clean (fixed) shape passes under it (previous
+    test).  Divergence itself is deterministic: the same seed re-run
+    produces the same diverging byte sequence — the repro contract."""
+    from benchmark.race_explore import run_pipeline_seed
+
+    import pytest
+
+    first = run_pipeline_seed(PINNED_SEED, str(tmp_path), mutated=True)
+    assert not first["ok"], (
+        "the planted RacyConsensus race no longer diverges at the "
+        "pinned seed — the dynamic half went blind"
+    )
+    again = run_pipeline_seed(PINNED_SEED, str(tmp_path), mutated=True)
+    if first["guard_tripped"] or again["guard_tripped"]:
+        # The wall-clock deadlock guard cut a run at a time-dependent
+        # point (pathologically slow host, e.g. under tracemalloc):
+        # byte-reproducibility is only promised for guard-free runs.
+        pytest.skip("wall-clock guard tripped; host too slow to pin bytes")
+    assert again["sequence_sha"] == first["sequence_sha"]
+    assert again["commits"] == first["commits"]
+
+
+def test_divergence_is_detected_by_the_audit_replay_too(tmp_path):
+    """The oracle replay is an independent judge: the racy run's audit
+    segment must fail replay (duplicate/lost commits), not just the
+    byte-compare against the golden walk."""
+    from benchmark.race_explore import run_pipeline_seed
+
+    report = run_pipeline_seed(PINNED_SEED, str(tmp_path), mutated=True)
+    assert not (report["identical_to_golden"] and report["audit_replay_ok"])
