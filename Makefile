@@ -11,8 +11,8 @@
 PYTHON ?= python
 
 .PHONY: check native lint lint-invariants test test-ci metrics-smoke \
-	fault-smoke fault-fuzz-smoke trajectory race-explore sanitize \
-	bench clean
+	trace-smoke fault-smoke fault-fuzz-smoke trajectory race-explore \
+	sanitize bench clean
 
 check: native lint test
 
@@ -60,6 +60,16 @@ metrics-smoke: native
 	JAX_PLATFORMS=cpu NARWHAL_METRICS_DUMP=.ci-artifacts \
 		$(PYTHON) -m pytest tests/test_metrics_pipeline.py -x -q
 	JAX_PLATFORMS=cpu $(PYTHON) benchmark/health_smoke.py
+
+# Committee flight-recorder + trace-export smoke (ISSUE 11): drive the
+# health-bench clean run (4-node local_bench with --trace-out) and drop
+# the exported Perfetto trace, the quiesce flight rings, and the scraped
+# timeline into .ci-artifacts/ for the workflow upload.  The test itself
+# round-trips the trace (8 process rows, ≥1 cross-process digest flow,
+# sampled-CPU track) and asserts every node's flight ring is populated.
+trace-smoke:
+	JAX_PLATFORMS=cpu NARWHAL_METRICS_DUMP=.ci-artifacts \
+		$(PYTHON) -m pytest tests/test_health_bench.py -x -q
 
 # Fault-injection smoke: the two CI scenarios (one Byzantine, one
 # crash/restart) through the scenario runner, each gated on the three

@@ -40,6 +40,7 @@ from __future__ import annotations
 import argparse
 import dataclasses
 import datetime
+import glob
 import json
 import os
 import shutil
@@ -227,6 +228,7 @@ def run_scenario(
     workdir: str,
     base_port: int = 9200,
     quiet: bool = False,
+    trace_out: Optional[str] = None,
 ) -> dict:
     """Run one arm; returns the artifact fragment for it."""
     kill_stale_nodes()
@@ -277,10 +279,14 @@ def run_scenario(
         with open(netem_path, "w") as f:
             json.dump(netem_cfg, f, indent=1)
 
+    # Flight-recorder dumps (503 transition / SIGTERM / task death) land
+    # here; a failed verdict attaches them to the artifact below.
+    flight_dir = f"{workdir}/flight"
     base_env = dict(
         os.environ,
         PYTHONPATH=REPO,
         NARWHAL_FAULT_SEED=str(scenario.seed),
+        NARWHAL_FLIGHT_DIR=flight_dir,
         **scenario.env,
     )
 
@@ -290,6 +296,7 @@ def run_scenario(
     primary_logs: Dict[int, List[str]] = {}
     incarnation: Dict[int, int] = {}
     scrape_targets = []
+    metrics_paths: List[str] = []
 
     def spawn(cmd, logfile, env) -> subprocess.Popen:
         f = open(logfile, "w")
@@ -320,6 +327,11 @@ def run_scenario(
         mport = metrics_port(base_port, scenario.nodes, scenario.workers, i)
         if inc == 0:
             scrape_targets.append((label, "127.0.0.1", mport))
+        # Post-mortem snapshot per INCARNATION: the trace exporter joins
+        # stage/round traces + flight rings across every file, so a
+        # crashed-and-restarted node contributes both lives to the trace.
+        mpath = f"{workdir}/metrics-{label}{suffix}.json"
+        metrics_paths.append(mpath)
         cmd = [
             sys.executable, "-m", "narwhal_tpu.node", "run",
             "--keys", f"{workdir}/node-{i}.json",
@@ -328,6 +340,7 @@ def run_scenario(
             "--store", f"{storedir}/db-primary-{i}",
             "--benchmark",
             "--metrics-port", str(mport),
+            "--metrics-path", mpath,
         ]
         extra = {"NARWHAL_CONSENSUS_AUDIT": audit}
         if i in plan_paths:
@@ -344,6 +357,8 @@ def run_scenario(
             )
             if inc == 0:
                 scrape_targets.append((label, "127.0.0.1", mport))
+            mpath = f"{workdir}/metrics-{label}{suffix}.json"
+            metrics_paths.append(mpath)
             wcmd = [
                 sys.executable, "-m", "narwhal_tpu.node", "run",
                 "--keys", f"{workdir}/node-{i}.json",
@@ -351,6 +366,7 @@ def run_scenario(
                 "--parameters", f"{workdir}/parameters.json",
                 "--store", f"{storedir}/db-worker-{i}-{wid}",
                 "--metrics-port", str(mport),
+                "--metrics-path", mpath,
             ]
             if i in plan_paths:
                 # One plan per authority, both roles: the worker acts on
@@ -465,6 +481,9 @@ def run_scenario(
         time.sleep(1.0)
 
     healthz = scraper.healthz_all()
+    # Every node's flight ring at quiesce: even a clean arm's artifact
+    # carries the committee's last-seconds event history.
+    flight_rings = scraper.flight_all()
     scraper.stop()
 
     # Graceful teardown (SIGTERM flushes final snapshots + audit tails).
@@ -556,7 +575,7 @@ def run_scenario(
         detection["ok"] = not fired
         detection["expected"] = []
 
-    return {
+    arm = {
         "scenario": dataclasses.asdict(scenario),
         "seed": scenario.seed,
         "verdicts": {
@@ -566,10 +585,34 @@ def run_scenario(
         },
         "ok": safety["ok"] and liveness["ok"] and detection["ok"],
         "timeline": timeline,
+        "flight": flight_rings,
         "audit_segments": {
             str(i): segs for i, segs in sorted(audit_segments.items())
         },
     }
+    if not arm["ok"]:
+        # A failed verdict ships the nodes' own dump files (503
+        # transition / SIGTERM / task death) alongside the scraped
+        # rings: the black boxes ARE the post-mortem.
+        dumps = {}
+        for path in sorted(glob.glob(f"{flight_dir}/flight-*.json")):
+            try:
+                with open(path) as f:
+                    dumps[os.path.basename(path)] = json.load(f)
+            except (OSError, ValueError):
+                continue
+        arm["flight_dumps"] = dumps
+    if trace_out:
+        from benchmark import trace_export
+
+        trace_export.export(
+            trace_export.load_named_snapshots(metrics_paths),
+            trace_out,
+            timeline=timeline,
+            flight=flight_rings,
+            quiet=quiet,
+        )
+    return arm
 
 
 def run(
@@ -578,12 +621,16 @@ def run(
     base_port: int = 9200,
     control: bool = True,
     quiet: bool = False,
+    trace_out: Optional[str] = None,
 ) -> dict:
-    """Fault arm + (optionally) clean-control arm; one artifact dict."""
+    """Fault arm + (optionally) clean-control arm; one artifact dict.
+    ``trace_out`` exports the FAULT arm as a Perfetto trace (the control
+    arm is a baseline, not a story worth a timeline)."""
     if not quiet:
         print(f"=== scenario {scenario.name} (fault arm)", file=sys.stderr)
     fault_arm = run_scenario(
-        scenario, os.path.join(workdir_root, scenario.name), base_port, quiet
+        scenario, os.path.join(workdir_root, scenario.name), base_port,
+        quiet, trace_out=trace_out,
     )
     artifact = {
         "name": scenario.name,
@@ -616,6 +663,11 @@ def main() -> int:
     parser.add_argument("--artifact", default=None,
                         help="write the artifact JSON here (one scenario) "
                         "or use it as a '{name}' template (several)")
+    parser.add_argument("--trace-out", default=None,
+                        help="export the fault arm as a Perfetto-loadable "
+                        "Chrome trace to this path (one scenario) or a "
+                        "'{name}' template (several) — see "
+                        "benchmark/trace_export.py")
     parser.add_argument("--workdir", default=os.path.join(REPO, ".fault_bench"))
     parser.add_argument("--base-port", type=int, default=9200)
     parser.add_argument("--skip-control", action="store_true",
@@ -632,6 +684,12 @@ def main() -> int:
             "--artifact must contain '{name}' when several --scenario/"
             "--fuzz-seed flags are given (a fixed path would silently "
             "overwrite each scenario's artifact with the next)"
+        )
+    if args.trace_out and n_runs > 1 and "{name}" not in args.trace_out:
+        parser.error(
+            "--trace-out must contain '{name}' when several --scenario/"
+            "--fuzz-seed flags are given (same overwrite hazard as "
+            "--artifact)"
         )
 
     # (scenario, generated-spec object or None) in CLI order.
@@ -680,6 +738,11 @@ def main() -> int:
             base_port=args.base_port,
             control=not args.skip_control,
             quiet=args.quiet,
+            trace_out=(
+                args.trace_out.replace("{name}", scenario.name)
+                if args.trace_out
+                else None
+            ),
         )
         out = args.artifact
         if out:

@@ -202,6 +202,7 @@ def run_bench(
     scrape_interval: float = 1.0,
     progress_wait: float = 0.0,
     loop_watchdog_ms: int = 0,
+    trace_out: str = None,
 ):
     """Run one committee + clients on localhost; return the ParseResult.
 
@@ -430,6 +431,7 @@ def run_bench(
     # progress at each tick, so mid-run stalls have a timestamp.
     scraper = None
     healthz = {}
+    flight_rings = {}
     if metrics_on:
         scraper = Scraper(scrape_targets, interval_s=scrape_interval).start()
     time.sleep(duration)
@@ -439,6 +441,9 @@ def run_bench(
         # Quiesce gate BEFORE teardown: a firing health rule on any live
         # node fails the run (appended to result.errors below).
         healthz = scraper.healthz_all()
+        # The flight rings ride along: even a clean run's bench JSON
+        # carries each node's last-seconds event history.
+        flight_rings = scraper.flight_all()
         scraper.stop()
 
     # SIGTERM first (lets NARWHAL_PROFILE dumps flush), then SIGKILL.
@@ -503,8 +508,23 @@ def run_bench(
             interval_s=scrape_interval,
             healthz=healthz,
         )
+        result.flight = flight_rings
         with open(f"{workdir}/timeline.json", "w") as f:
             json.dump(result.timeline, f, indent=1)
+        if trace_out:
+            # One Perfetto-loadable trace of the whole committee run:
+            # the final snapshots carry the stage/round traces, flight
+            # rings and profiler timelines; the scraped timeline adds
+            # the committee-wide rate counters and health transitions.
+            from benchmark import trace_export
+
+            trace_export.export(
+                trace_export.load_named_snapshots(metrics_paths),
+                trace_out,
+                timeline=result.timeline,
+                flight=flight_rings,
+                quiet=quiet,
+            )
     if not keep_logs:
         for i in range(alive):
             shutil.rmtree(f"{storedir}/db-primary-{i}", ignore_errors=True)
@@ -543,6 +563,14 @@ def main():
         "section (runtime.loop_stall_seconds series) in the bench JSON; "
         "0 = off",
     )
+    parser.add_argument(
+        "--trace-out",
+        default=None,
+        help="Export the whole run as ONE Perfetto-loadable Chrome trace "
+        "(process row per node, flow arrows per committed digest, health/"
+        "flight instants, sampled-CPU track) to this path — see "
+        "benchmark/trace_export.py",
+    )
     parser.add_argument("--crypto-backend", choices=["cpu", "tpu"], default=None)
     parser.add_argument(
         "--experimental-consensus-kernel",
@@ -575,6 +603,7 @@ def main():
         consensus_kernel=args.consensus_kernel,
         tpu_primaries=args.tpu_primaries,
         loop_watchdog_ms=args.loop_watchdog_ms,
+        trace_out=args.trace_out,
     )
     if result.errors:
         print("ERRORS detected in logs:", file=sys.stderr)
@@ -614,6 +643,9 @@ def main():
                     # Live committee timeline (scraper): per-node series,
                     # per-peer RTT matrix, /healthz verdicts at quiesce.
                     "timeline": result.timeline,
+                    # Per-node flight-recorder rings pulled at quiesce
+                    # (/debug/flight): the last-seconds event history.
+                    "flight": result.flight,
                 }
             )
         )

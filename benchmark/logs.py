@@ -116,6 +116,10 @@ class ParseResult:
     # populated when the run armed the loop-stall watchdog
     # (NARWHAL_LOOP_WATCHDOG_MS / local_bench --loop-watchdog-ms).
     runtime: Dict = field(default_factory=dict)
+    # Per-node flight-recorder rings pulled from /debug/flight at quiesce
+    # (benchmark/scraper.py flight_all): {node: {"events": […], …}} —
+    # the last-seconds event history every run carries, clean or not.
+    flight: Dict = field(default_factory=dict)
 
     def summary(self, rate: int, tx_size: int, nodes: int, workers: int) -> str:
         return (

@@ -35,11 +35,17 @@ import heapq
 import json
 import os
 import sys
-from typing import Iterable, Iterator, List, TextIO, Tuple
+from typing import Iterable, Iterator, List, Optional, TextIO, Tuple
 
 
-def _records(path: str, text: Iterable[str]) -> Iterator[Tuple[float, dict]]:
-    """(ts, record) per line of one node's stream."""
+def _records(
+    path: str, text: Iterable[str]
+) -> Iterator[Tuple[float, dict, str]]:
+    """(ts, record, source stem) per line of one node's stream.  The
+    stem rides alongside (never in the output record): it is the name
+    the bench workdir uses for the node ('primary-0'), which is how
+    --trace maps records onto trace rows when the in-record node id is
+    the runtime form ('primary-<keyprefix>')."""
     stem = os.path.splitext(os.path.basename(path))[0]
     last_ts = 0.0
     for line in text:
@@ -58,23 +64,103 @@ def _records(path: str, text: Iterable[str]) -> Iterator[Tuple[float, dict]]:
         else:
             rec["ts"] = last_ts
         rec.setdefault("node", stem)
-        yield (rec["ts"], rec)
+        yield (rec["ts"], rec, stem)
 
 
 def merge_streams(
-    named_texts: List[Tuple[str, Iterable[str]]], out: TextIO
+    named_texts: List[Tuple[str, Iterable[str]]],
+    out: Optional[TextIO],
+    on_record=None,
 ) -> int:
     """K-way timestamp merge; returns the number of records written.
     ``named_texts`` is [(source name, line iterable), …] — file handles,
     lists of lines in tests, anything iterable.  heapq.merge with a key
     is stable, so same-timestamp records keep within-file order and the
-    record dicts themselves are never compared."""
+    record dicts themselves are never compared.  ``out=None`` skips the
+    JSONL output (trace-annotation-only runs); ``on_record`` sees every
+    merged record (the ``--trace`` hook)."""
     streams = [_records(name, text) for name, text in named_texts]
     n = 0
-    for _, rec in heapq.merge(*streams, key=lambda t: t[0]):
-        out.write(json.dumps(rec) + "\n")
+    for _, rec, stem in heapq.merge(*streams, key=lambda t: t[0]):
+        if out is not None:
+            out.write(json.dumps(rec) + "\n")
+        if on_record is not None:
+            on_record(rec, stem)
         n += 1
     return n
+
+
+# Beyond this many log instants, the injected lines are level-filtered
+# then evenly sampled — a DEBUG-level committee day would otherwise bury
+# the trace UI; `logs_dropped` in the trace metadata records the cut.
+MAX_LOG_EVENTS = 20_000
+
+
+def inject_into_trace(
+    trace_path: str,
+    records: List[Tuple[dict, str]],
+    max_events: int = MAX_LOG_EVENTS,
+) -> Tuple[int, int]:
+    """Interleave merged log records into an exported Chrome trace
+    (benchmark/trace_export.py) as instant events on each node's row —
+    log context and stage spans on ONE timeline.  ``records`` is
+    ``[(record, source stem), …]``: a record maps onto a trace row by
+    its in-record node id when that matches directly, else by its
+    source FILE stem — bench workdirs name both the log file and the
+    metrics snapshot (hence the trace row) 'primary-0', while the
+    --log-json records themselves carry the runtime id
+    'primary-<keyprefix>', which no trace knows.  Records matching
+    neither way (e.g. client logs) are dropped with a count.  Returns
+    (injected, dropped).  The trace is rewritten atomically."""
+    with open(trace_path) as f:
+        trace = json.load(f)
+    meta = trace.get("metadata") or {}
+    pids = meta.get("node_pids") or {}
+    t0 = meta.get("epoch_t0") or 0.0
+    if not pids:
+        raise SystemExit(
+            f"{trace_path} carries no metadata.node_pids — was it "
+            "exported by benchmark/trace_export.py?"
+        )
+
+    candidates = []
+    dropped = 0
+    for rec, stem in records:
+        pid = pids.get(str(rec.get("node", ""))) or pids.get(stem)
+        ts = rec.get("ts")
+        if pid is None or not isinstance(ts, (int, float)):
+            dropped += 1
+            continue
+        candidates.append((pid, ts, rec))
+    if len(candidates) > max_events:
+        keep = [
+            c for c in candidates
+            if c[2].get("level") not in ("DEBUG", "RAW")
+        ]
+        if len(keep) > max_events:
+            step = len(keep) / max_events
+            keep = [keep[int(i * step)] for i in range(max_events)]
+        dropped += len(candidates) - len(keep)
+        candidates = keep
+    for pid, ts, rec in candidates:
+        trace["traceEvents"].append({
+            "ph": "i", "pid": pid, "tid": 3, "s": "t",  # TID_EVENTS row
+            "name": f"log:{rec.get('level', '?')}",
+            "cat": "log",
+            "ts": int(round((ts - t0) * 1e6)),
+            "args": {
+                "logger": rec.get("logger"),
+                "msg": str(rec.get("msg", ""))[:2000],
+            },
+        })
+    meta["logs_injected"] = meta.get("logs_injected", 0) + len(candidates)
+    meta["logs_dropped"] = meta.get("logs_dropped", 0) + dropped
+    trace["metadata"] = meta
+    tmp = trace_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(trace, f)
+    os.replace(tmp, trace_path)
+    return len(candidates), dropped
 
 
 def main(argv=None) -> int:
@@ -87,25 +173,52 @@ def main(argv=None) -> int:
         "-o",
         "--output",
         default=None,
-        help="output path (default: stdout)",
+        help="output path (default: stdout; with --trace and no -o, the "
+        "JSONL output is skipped and only the trace is annotated)",
+    )
+    parser.add_argument(
+        "--trace",
+        default=None,
+        help="ALSO interleave the merged records into this exported "
+        "Chrome trace (benchmark/trace_export.py output) as instant "
+        "events on each node's row, so log context and stage spans "
+        "live on one timeline (rewritten atomically)",
     )
     args = parser.parse_args(argv)
 
+    collected: List[Tuple[dict, str]] = []
     handles = [open(p) for p in args.logs]
     try:
+        on_record = (
+            (lambda rec, stem: collected.append((rec, stem)))
+            if args.trace
+            else None
+        )
         if args.output:
             with open(args.output, "w") as out:
-                n = merge_streams(list(zip(args.logs, handles)), out)
+                n = merge_streams(
+                    list(zip(args.logs, handles)), out, on_record
+                )
             print(
                 f"merged {n} records from {len(args.logs)} node(s) "
                 f"into {args.output}",
                 file=sys.stderr,
             )
+        elif args.trace:
+            merge_streams(list(zip(args.logs, handles)), None, on_record)
         else:
             merge_streams(list(zip(args.logs, handles)), sys.stdout)
     finally:
         for h in handles:
             h.close()
+    if args.trace:
+        injected, dropped = inject_into_trace(args.trace, collected)
+        print(
+            f"injected {injected} log instant(s) into {args.trace}"
+            + (f" ({dropped} dropped: unknown node / past cap)"
+               if dropped else ""),
+            file=sys.stderr,
+        )
     return 0
 
 

@@ -406,6 +406,9 @@ def run_remote_bench(
     scraper.wait_for_payload_commits(progress_wait, quiet=quiet)
     # Quiesce gate BEFORE teardown: any firing health rule fails the run.
     healthz = scraper.healthz_all()
+    # Flight rings ride along (same convention as local_bench): each
+    # node's last-seconds event history in the bench JSON.
+    flight_rings = scraper.flight_all()
     scraper.stop()
 
     for r in runners:
@@ -453,6 +456,7 @@ def run_remote_bench(
         quorum_weight=committee.quorum_threshold(),
     )
     result.wire, result.crypto = wc["wire"], wc["crypto"]
+    result.flight = flight_rings
     with open(f"{stage}/timeline.json", "w") as f:
         json.dump(result.timeline, f, indent=1)
     for r in runners:
@@ -559,6 +563,7 @@ def main() -> None:
                     "wire": result.wire,
                     "crypto": result.crypto,
                     "timeline": result.timeline,
+                    "flight": result.flight,
                 }
             )
         )

@@ -167,6 +167,21 @@ class Scraper:
             remaining = retry
         return out
 
+    def flight_all(self) -> Dict[str, Optional[dict]]:
+        """One concurrent ``/debug/flight`` round — each node's bounded
+        event ring at quiesce, embedded in the bench JSON so even clean
+        runs carry their last-seconds event history.  A node that cannot
+        answer yields None (same stance as /healthz: pulling the black
+        box must never fail the run)."""
+        out: Dict[str, Optional[dict]] = {}
+        rings = self._pool.map(
+            lambda t: fetch_json(t[1], t[2], "/debug/flight", self.timeout_s),
+            self.targets,
+        )
+        for target, (status, body) in zip(self.targets, rings):
+            out[target[0]] = body if status == 200 else None
+        return out
+
     def _max_counter(self, name: str) -> int:
         return int(
             max(

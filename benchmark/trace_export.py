@@ -1,0 +1,518 @@
+"""Unified committee trace: every node's story on ONE Perfetto timeline.
+
+The observability planes built so far each answer their own question —
+per-digest stage traces (where did one batch's latency go), per-round
+cadence traces (where did the round period go), the health/flight event
+streams (what anomalies fired), the loop-stall watchdog (who held the
+loop), the sampling profiler (where did the CPU go) — but each lives in
+its own JSON and its own mental model.  This exporter joins ALL of them
+into one Chrome-trace-event file that ``ui.perfetto.dev`` (or
+``chrome://tracing``) renders directly:
+
+- one **process row per node process** (primary-0 … worker-3-0), with
+  per-row tracks for the digest pipeline, the round cadence, instant
+  events (health transitions, flight-ring landmarks, merged log lines),
+  sampled CPU (the profiler's main-thread leaf timeline), and counters
+  (per-tick wire/commit deltas);
+- **flow arrows per committed digest** following seal → quorum →
+  digest-at-primary → header → cert → commit ACROSS processes — the
+  cross-process causal chain the paper's pipeline argument is about,
+  drawn instead of tabulated;
+- instant events carry their structured payloads in ``args``, so
+  clicking a health FIRING in the UI shows the rule detail.
+
+Inputs are the artifacts a bench run already leaves behind: the per-node
+``--metrics-path`` snapshots (stage/round traces + flight ring +
+profiler timeline ride in every final snapshot) and optionally the
+scraped ``timeline.json``.  Both harnesses grow ``--trace-out`` to
+invoke this directly; standalone:
+
+    python -m benchmark.trace_export --workdir .bench -o trace.json
+    # then open trace.json in https://ui.perfetto.dev
+
+``benchmark/logs_merge.py --trace trace.json`` interleaves merged
+``--log-json`` streams into an exported trace afterwards, so log context
+and stage spans live on one timeline.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from narwhal_tpu.metrics import ROUND_STAGES, STAGES  # noqa: E402
+
+# Per-process track (tid) layout.  Fixed small integers: Perfetto sorts
+# tracks by tid within a process, so the pipeline sits on top.
+TID_PIPELINE = 1   # per-digest stage slices + flow bindings
+TID_ROUNDS = 2     # per-round cadence slices
+TID_EVENTS = 3     # instants: health/flight landmarks, merged log lines
+TID_CPU = 4        # sampling profiler: main-thread leaf runs
+_TRACK_NAMES = {
+    TID_PIPELINE: "pipeline (per-digest)",
+    TID_ROUNDS: "rounds (cadence)",
+    TID_EVENTS: "events",
+    TID_CPU: "cpu (sampled)",
+}
+
+_STAGE_IDX = {s: i for i, s in enumerate(STAGES)}
+
+# Beyond this many committed digests, flows are sampled evenly — a
+# 60 s bench commits tens of thousands and Perfetto renders arrows per
+# flow; the cap keeps the file loadable while `flows_dropped` in the
+# metadata says exactly what was left out (no silent truncation).
+MAX_FLOWS = 512
+
+
+def _us(ts: float, t0: float) -> int:
+    return int(round((ts - t0) * 1e6))
+
+
+class _TraceBuilder:
+    def __init__(self) -> None:
+        self.events: List[dict] = []
+
+    def slice(self, pid: int, tid: int, name: str, ts_us: int,
+              dur_us: int, cat: str, args: Optional[dict] = None) -> None:
+        ev = {
+            "ph": "X", "pid": pid, "tid": tid, "name": name, "cat": cat,
+            "ts": ts_us, "dur": max(1, dur_us),
+        }
+        if args:
+            ev["args"] = args
+        self.events.append(ev)
+
+    def instant(self, pid: int, tid: int, name: str, ts_us: int,
+                cat: str, args: Optional[dict] = None) -> None:
+        ev = {
+            "ph": "i", "pid": pid, "tid": tid, "name": name, "cat": cat,
+            "ts": ts_us, "s": "t",  # thread-scoped instant
+        }
+        if args:
+            ev["args"] = args
+        self.events.append(ev)
+
+    def counter_track(self, pid: int, name: str, ts_us: int,
+                values: Dict[str, float]) -> None:
+        self.events.append({
+            "ph": "C", "pid": pid, "tid": 0, "name": name,
+            "cat": "counters", "ts": ts_us, "args": values,
+        })
+
+    def flow(self, ph: str, flow_id: str, pid: int, tid: int,
+             ts_us: int) -> None:
+        ev = {
+            "ph": ph, "pid": pid, "tid": tid, "ts": ts_us,
+            "name": "digest", "cat": "digest-flow", "id": flow_id,
+        }
+        if ph == "f":
+            ev["bp"] = "e"  # bind to the enclosing slice at the sink
+        self.events.append(ev)
+
+
+def build_trace(
+    snapshots: List[Tuple[str, dict]],
+    timeline: Optional[dict] = None,
+    flight: Optional[Dict[str, dict]] = None,
+    max_flows: int = MAX_FLOWS,
+) -> dict:
+    """Join per-node registry snapshots (+ optional scraped timeline and
+    /debug/flight rings) into one Chrome-trace-event JSON document.
+
+    ``snapshots`` is ``[(node_name, snapshot_dict), …]`` — the final
+    ``--metrics-path`` files of a bench run, in any order (rows sort by
+    name, primaries first).  ``flight`` optionally supplies per-node
+    rings scraped at quiesce for nodes whose snapshot predates theirs.
+    """
+    # Primaries first, then workers, each numerically ordered — the row
+    # layout a reader scans top-to-bottom.
+    def row_key(name: str) -> tuple:
+        parts = name.replace("-", " ").split()
+        nums = tuple(int(p) for p in parts if p.isdigit())
+        return (0 if name.startswith("primary") else 1, nums, name)
+
+    snapshots = sorted(snapshots, key=lambda kv: row_key(kv[0]))
+    pids = {name: i + 1 for i, (name, _) in enumerate(snapshots)}
+    # Events are built on ABSOLUTE epoch microseconds and rebased to the
+    # earliest one at the end — no surface (profiler boots before the
+    # first stage stamp) can land before the computed origin.
+    t0 = 0.0
+    b = _TraceBuilder()
+
+    for name, _ in snapshots:
+        pid = pids[name]
+        b.events.append({
+            "ph": "M", "pid": pid, "tid": 0, "name": "process_name",
+            "args": {"name": name},
+        })
+        b.events.append({
+            "ph": "M", "pid": pid, "tid": 0, "name": "process_sort_index",
+            "args": {"sort_index": pid},
+        })
+        for tid, tname in _TRACK_NAMES.items():
+            b.events.append({
+                "ph": "M", "pid": pid, "tid": tid, "name": "thread_name",
+                "args": {"name": tname},
+            })
+
+    # -- per-node surfaces ----------------------------------------------------
+    # digest -> [(pid, stage, ts, slice_start_ts)] for the flow pass;
+    # slice_start_ts is the start of the slice a flow event can bind to.
+    flow_anchor: Dict[str, List[Tuple[int, str, float]]] = {}
+    for name, snap in snapshots:
+        pid = pids[name]
+        _emit_digest_slices(b, pid, snap, t0, flow_anchor)
+        _emit_round_slices(b, pid, snap, t0)
+        ring = (snap.get("detail") or {}).get("flight.ring") or {}
+        scraped = (flight or {}).get(name)
+        if scraped and _ring_newest(scraped) > _ring_newest(ring):
+            # Two copies of the same bounded deque exist: the quiesce
+            # scrape and the snapshot's.  In the normal teardown order
+            # (scrape → SIGTERM → final snapshot flush) the SNAPSHOT is
+            # the superset — it carries the quiesce-to-shutdown tail —
+            # but a node SIGKILLed mid-run has only a stale periodic
+            # snapshot while the scrape saw it live.  Newest event wins.
+            ring = scraped
+        _emit_flight(b, pid, ring, t0)
+        _emit_profile(b, pid, snap, t0)
+        _emit_health_events(
+            b, pid, ((snap.get("health") or {}).get("events")) or [], t0
+        )
+        last_stall = (snap.get("detail") or {}).get("runtime.loop_stall_last")
+        if last_stall and last_stall.get("ts"):
+            b.instant(
+                pid, TID_EVENTS, "loop_stall_stack",
+                _us(last_stall["ts"], t0), "runtime",
+                {k: str(v)[:2000] for k, v in last_stall.items()},
+            )
+
+    # -- committee-wide surfaces ---------------------------------------------
+    if timeline:
+        _emit_timeline(b, pids, timeline, t0)
+
+    # -- cross-process digest flows -------------------------------------------
+    flows, flows_total = _emit_flows(b, flow_anchor, t0, max_flows)
+
+    # Rebase to the earliest emitted timestamp (metadata events carry no
+    # ts and stay put).
+    stamped = [e["ts"] for e in b.events if "ts" in e]
+    origin_us = min(stamped) if stamped else 0
+    for e in b.events:
+        if "ts" in e:
+            e["ts"] -= origin_us
+
+    b.events.sort(key=lambda e: (e.get("ts", 0), e["ph"] != "M"))
+    return {
+        "traceEvents": b.events,
+        "displayTimeUnit": "ms",
+        "metadata": {
+            "generated_by": "benchmark/trace_export.py",
+            "epoch_t0": origin_us / 1e6,
+            "node_pids": pids,
+            "flows_emitted": flows,
+            "flows_total": flows_total,
+            "flows_dropped": flows_total - flows,
+        },
+    }
+
+
+def _emit_digest_slices(b, pid, snap, t0, flow_anchor) -> None:
+    """Leg slices between consecutive stage stamps a node owns, plus the
+    flow anchors (digest → slice starts) the flow pass binds arrows to."""
+    for digest, entry in (snap.get("trace") or {}).items():
+        stamps = sorted(
+            ((s, entry[s]) for s in STAGES if s in entry),
+            key=lambda kv: _STAGE_IDX[kv[0]],
+        )
+        if not stamps:
+            continue
+        short = digest[:12]
+        anchors = flow_anchor.setdefault(digest, [])
+        for (s_a, t_a), (s_b, t_b) in zip(stamps, stamps[1:]):
+            if t_b < t_a:
+                continue  # clock skew across threads; skip the leg
+            b.slice(
+                pid, TID_PIPELINE, f"{s_a}→{s_b}",
+                _us(t_a, t0), _us(t_b, t0) - _us(t_a, t0),
+                "stage", {"digest": short},
+            )
+            anchors.append((pid, s_a, t_a))
+        # A lone trailing stamp still anchors the chain's end (commit on
+        # a primary whose slice ends there): bind at the LAST slice start.
+        if len(stamps) == 1:
+            b.instant(
+                pid, TID_PIPELINE, stamps[0][0],
+                _us(stamps[0][1], t0), "stage", {"digest": short},
+            )
+            anchors.append((pid, stamps[0][0], stamps[0][1]))
+
+
+def _emit_round_slices(b, pid, snap, t0) -> None:
+    ridx = {s: i for i, s in enumerate(ROUND_STAGES)}
+    for rnd, entry in (snap.get("round_trace") or {}).items():
+        stamps = sorted(
+            ((s, entry[s]) for s in ROUND_STAGES if s in entry),
+            key=lambda kv: ridx[kv[0]],
+        )
+        if len(stamps) < 2:
+            continue
+        start, end = stamps[0][1], max(t for _, t in stamps)
+        if end < start:
+            continue
+        b.slice(
+            pid, TID_ROUNDS, f"round {rnd}",
+            _us(start, t0), _us(end, t0) - _us(start, t0),
+            "round", {"round": rnd},
+        )
+        for (s_a, t_a), (s_b, t_b) in zip(stamps, stamps[1:]):
+            if t_b < t_a:
+                continue  # pipelined overlap (legal; see round_attribution)
+            b.slice(
+                pid, TID_ROUNDS, f"{s_a}→{s_b}",
+                _us(t_a, t0), _us(t_b, t0) - _us(t_a, t0),
+                "round-leg", {"round": rnd},
+            )
+
+
+def _ring_newest(ring: dict) -> float:
+    """Timestamp of a flight ring's newest event (0.0 when empty)."""
+    ts = [
+        ev.get("t") for ev in (ring or {}).get("events") or []
+        if isinstance(ev.get("t"), (int, float))
+    ]
+    return max(ts) if ts else 0.0
+
+
+def _emit_flight(b, pid, ring: dict, t0) -> None:
+    for ev in ring.get("events") or []:
+        t = ev.get("t")
+        if not isinstance(t, (int, float)):
+            continue
+        kind = ev.get("kind", "event")
+        if kind == "tick":
+            # Tick deltas render as counter tracks, not instants.
+            d = ev.get("d") or {}
+            vals = {k: v for k, v in d.items() if isinstance(v, (int, float))}
+            if vals:
+                b.counter_track(pid, "flight ticks", _us(t, t0), vals)
+            continue
+        args = {k: v for k, v in ev.items() if k not in ("t", "kind")}
+        b.instant(pid, TID_EVENTS, f"flight:{kind}", _us(t, t0),
+                  "flight", args or None)
+
+
+def _emit_profile(b, pid, snap, t0) -> None:
+    runs = (snap.get("detail") or {}).get("profile.timeline") or []
+    for run in runs:
+        try:
+            start, end, samples, label = run
+        except (TypeError, ValueError):
+            continue
+        if not isinstance(start, (int, float)) or end < start:
+            continue
+        b.slice(
+            pid, TID_CPU, str(label), _us(start, t0),
+            max(1, _us(end, t0) - _us(start, t0)),
+            "cpu", {"samples": samples},
+        )
+
+
+def _emit_health_events(b, pid, events: List[dict], t0) -> None:
+    for ev in events:
+        t = ev.get("t")
+        if not isinstance(t, (int, float)):
+            continue
+        b.instant(
+            pid, TID_EVENTS,
+            f"health:{ev.get('rule')}:{ev.get('event')}",
+            _us(t, t0), "health",
+            {"subject": ev.get("subject"), "detail": ev.get("detail")},
+        )
+
+
+def _emit_timeline(b, pids, timeline: dict, t0) -> None:
+    """Scraped committee timeline: per-node rate counters plus any
+    committee-wide health transitions the snapshots missed."""
+    for name, series in (timeline.get("nodes") or {}).items():
+        pid = pids.get(name)
+        if pid is None:
+            continue
+        for point in series:
+            t = point.get("t")
+            if not isinstance(t, (int, float)):
+                continue
+            vals = {}
+            for key in ("commit_rate_per_s", "txs_sealed_per_s",
+                        "pending_acks"):
+                v = point.get(key)
+                if isinstance(v, (int, float)):
+                    vals[key] = v
+            if vals:
+                b.counter_track(pid, "scraped rates", _us(t, t0), vals)
+    for ev in timeline.get("events") or []:
+        pid = pids.get(ev.get("node"))
+        t = ev.get("t")
+        if pid is None or not isinstance(t, (int, float)):
+            continue
+        b.instant(
+            pid, TID_EVENTS,
+            f"health:{ev.get('rule')}:{ev.get('event')}",
+            _us(t, t0), "health",
+            {"subject": ev.get("subject"), "detail": ev.get("detail")},
+        )
+
+
+def _emit_flows(b, flow_anchor, t0, max_flows: int) -> Tuple[int, int]:
+    """One s/t…t/f flow chain per committed digest, bound to the slice
+    starts recorded as anchors; returns (emitted, eligible).  Eligible =
+    digests whose chain actually crosses processes — a batch sealed but
+    never committed (teardown in flight) has anchors on one row only and
+    is no flow, not a capped one."""
+    committed = {
+        d: anchors
+        for d, anchors in flow_anchor.items()
+        if len({pid for pid, _, _ in anchors}) >= 2  # crosses processes
+        and any(s == "seal" for _, s, _ in anchors)
+    }
+    digests = sorted(committed)
+    if len(digests) > max_flows:
+        step = len(digests) / max_flows
+        digests = [digests[int(i * step)] for i in range(max_flows)]
+    for digest in digests:
+        # Chain in causal-stage then time order, ONE anchor per
+        # (pid, stage): zigzag across rows is the point.
+        anchors = sorted(
+            {(pid, s): t for pid, s, t in committed[digest]}.items(),
+            key=lambda kv: (_STAGE_IDX[kv[0][1]], kv[1]),
+        )
+        if len(anchors) < 2:
+            continue
+        flow_id = digest[:16]
+        for i, ((pid, _), t) in enumerate(anchors):
+            ph = "s" if i == 0 else ("f" if i == len(anchors) - 1 else "t")
+            b.flow(ph, flow_id, pid, TID_PIPELINE, _us(t, t0))
+    return len(digests), len(committed)
+
+
+# -- harness entry points ------------------------------------------------------
+
+def load_named_snapshots(paths: List[str]) -> List[Tuple[str, dict]]:
+    """[(node name, snapshot dict)] from ``metrics-<node>.json`` paths —
+    ONE definition of the stem→row-name convention for both harnesses
+    and the workdir loader (a naming change updating only one copy would
+    silently mis-row the trace).  Missing/torn files are skipped (the
+    harnesses' load_snapshots already reported those into
+    result.errors)."""
+    out = []
+    for p in paths:
+        name = os.path.basename(p)
+        if name.startswith("metrics-"):
+            name = name[len("metrics-"):]
+        if name.endswith(".json"):
+            name = name[: -len(".json")]
+        try:
+            with open(p) as f:
+                out.append((name, json.load(f)))
+        except (OSError, ValueError) as e:
+            print(f"WARNING: skipping {p}: {e}", file=sys.stderr)
+    return out
+
+
+def load_workdir(workdir: str) -> Tuple[List[Tuple[str, dict]], Optional[dict]]:
+    """(snapshots, timeline) from a bench workdir: every
+    ``metrics-<node>.json`` plus ``timeline.json`` when present."""
+    snapshots = load_named_snapshots(
+        sorted(glob.glob(os.path.join(workdir, "metrics-*.json")))
+    )
+    timeline = None
+    tpath = os.path.join(workdir, "timeline.json")
+    if os.path.exists(tpath):
+        try:
+            with open(tpath) as f:
+                timeline = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"WARNING: skipping {tpath}: {e}", file=sys.stderr)
+    return snapshots, timeline
+
+
+def export(
+    snapshots: List[Tuple[str, dict]],
+    out_path: str,
+    timeline: Optional[dict] = None,
+    flight: Optional[Dict[str, dict]] = None,
+    quiet: bool = False,
+) -> dict:
+    """Build and atomically write one trace; returns the trace dict."""
+    trace = build_trace(snapshots, timeline=timeline, flight=flight)
+    os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+    tmp = out_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(trace, f)
+    os.replace(tmp, out_path)
+    if not quiet:
+        md = trace["metadata"]
+        print(
+            f"trace -> {out_path}: {len(trace['traceEvents'])} events, "
+            f"{len(md['node_pids'])} process rows, "
+            f"{md['flows_emitted']}/{md['flows_total']} digest flows"
+            + (
+                f" ({md['flows_dropped']} dropped past the "
+                f"{MAX_FLOWS}-flow cap)"
+                if md["flows_dropped"]
+                else ""
+            )
+            + " — open in https://ui.perfetto.dev",
+            file=sys.stderr,
+        )
+    return trace
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Join a bench run's per-node metrics snapshots into "
+        "one Perfetto-loadable Chrome trace (process row per node, flow "
+        "arrows per committed digest)."
+    )
+    parser.add_argument(
+        "--workdir", default=None,
+        help="bench workdir holding metrics-*.json (+ timeline.json), "
+        "e.g. .bench",
+    )
+    parser.add_argument(
+        "--snapshot", action="append", default=[],
+        help="explicit name=path snapshot (repeatable; alternative to "
+        "--workdir)",
+    )
+    parser.add_argument("--timeline", default=None,
+                        help="scraped timeline.json (optional)")
+    parser.add_argument("-o", "--out", required=True)
+    args = parser.parse_args(argv)
+
+    snapshots: List[Tuple[str, dict]] = []
+    timeline = None
+    if args.workdir:
+        snapshots, timeline = load_workdir(args.workdir)
+    for spec in args.snapshot:
+        name, _, path = spec.partition("=")
+        if not path:
+            parser.error(f"--snapshot wants name=path, got {spec!r}")
+        with open(path) as f:
+            snapshots.append((name, json.load(f)))
+    if args.timeline:
+        with open(args.timeline) as f:
+            timeline = json.load(f)
+    if not snapshots:
+        parser.error("no snapshots found (--workdir empty? --snapshot?)")
+    export(snapshots, args.out, timeline=timeline)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

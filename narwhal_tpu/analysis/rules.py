@@ -338,7 +338,7 @@ _CTX_EXACT_FNS = {"counter", "gauge", "rate", "last_change_age"}
 _CTX_PREFIX_FNS = {"gauges_prefixed", "rates_prefixed"}
 _METRIC_ROOTS = (
     "primary", "worker", "consensus", "net", "store", "crypto", "wire",
-    "metrics", "faults", "runtime",
+    "metrics", "faults", "runtime", "profile", "flight",
 )
 _METRIC_NAME_RE = re.compile(
     r"(?:%s)(?:\.[a-z0-9_]+)+\.?" % "|".join(_METRIC_ROOTS)

@@ -112,6 +112,11 @@ class LoopWatchdog:
                 self._m_stall_s.observe(overshoot)
                 self._last_stall["stall_s"] = round(overshoot, 4)
                 self._last_stall["ts"] = time.time()
+                # Stalls are flight-recorder landmarks: the ring shows
+                # what the committee was doing around the freeze.
+                metrics.flight_event(
+                    "loop_stall", stall_s=round(overshoot, 4)
+                )
 
     # -- thread side: name the culprit ----------------------------------------
 

@@ -602,6 +602,18 @@ class Consensus:
                     self._m_commits.inc(len(sequence))
                     self._m_commit_batch.observe(len(sequence))
                     self._m_walk.observe(t_walk - t0)
+                    # Flight-ring landmark: one event per commit burst
+                    # (not per cert — bursts are the protocol unit and
+                    # the ring is bounded).
+                    metrics.flight_event(
+                        "commit",
+                        certs=len(sequence),
+                        batches=sum(
+                            len(c.header.payload) for c in sequence
+                        ),
+                        round=state.last_committed_round,
+                        walk_ms=round(1000 * (t_walk - t0), 2),
+                    )
                 for committed in sequence:
                     if self._audit is not None:
                         self._audit.commit(committed)

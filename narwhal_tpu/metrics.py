@@ -58,7 +58,7 @@ from typing import (
     Tuple,
 )
 
-from .utils.env import env_flag, env_float, env_int
+from .utils.env import env_flag, env_float, env_int, env_str
 
 log = logging.getLogger("narwhal.metrics")
 
@@ -338,6 +338,174 @@ class WireLedger:
         # and are zeroed by Registry.reset's counter sweep).
 
 
+class FlightRecorder:
+    """Bounded ring of recent structured events — the per-node black box.
+
+    The post-mortem snapshot says *what the totals were*; the scraper
+    timeline says *what the rates were*; neither says what the node was
+    DOING in its last seconds.  The flight recorder keeps a bounded ring
+    of recent structured events:
+
+    - protocol landmarks — commit bursts (``Consensus.run``), round
+      advances (``Proposer._advance``);
+    - health-rule FIRING/cleared transitions (:class:`HealthMonitor`);
+    - event-loop stalls (analysis/watchdog.py) and unhandled background
+      task deaths (utils/tasks.py);
+    - one ``tick`` per interval with the deltas that contextualize the
+      rest: wire bytes in/out, commits, sealed txs, round, pending ACKs
+      (the :meth:`run` loop, spawned by node/main.py).
+
+    The ring rides in every registry snapshot (``flight.ring`` detail),
+    answers live on ``GET /debug/flight`` (MetricsServer), and **dumps
+    atomically to a file** (``NARWHAL_FLIGHT_DIR``) at the moments a
+    post-mortem needs it most: the /healthz ok→failing (503) transition,
+    SIGTERM, and an unhandled task death — the bench/fault harnesses set
+    the directory and attach the dumps to failed verdict artifacts.
+
+    Recording is one dict append into a deque; safe from any thread
+    (deque.append is atomic), free when the registry is stubbed.
+    """
+
+    __slots__ = ("registry", "enabled", "events", "dumps", "dir", "node_id",
+                 "_m_events", "_m_dumps", "_last_tick", "_seq")
+
+    def __init__(self, reg: "Registry", cap: Optional[int] = None) -> None:
+        self.registry = reg
+        # NARWHAL_FLIGHT=0 stubs the recorder alone (the A/B overhead
+        # arm's knob), NARWHAL_METRICS=0 stubs it with everything else.
+        self.enabled = reg.enabled and env_flag("NARWHAL_FLIGHT")
+        if cap is None:
+            cap = env_int("NARWHAL_FLIGHT_CAP")
+        self.events: Deque[dict] = collections.deque(maxlen=max(16, cap))
+        self.dumps: List[dict] = []  # [{reason, ts, path}] — dump markers
+        self.dir: Optional[str] = env_str("NARWHAL_FLIGHT_DIR")
+        self.node_id = ""  # node/main.py stamps role-keyprefix
+        self._last_tick: Dict[str, float] = {}
+        self._seq = 0
+        if self.enabled:
+            self._m_events = reg.counter("flight.events")
+            self._m_dumps = reg.counter("flight.dumps")
+            reg.detail_fn("flight.ring", self.snapshot)
+        else:
+            self._m_events = _NULL  # type: ignore[assignment]
+            self._m_dumps = _NULL  # type: ignore[assignment]
+
+    def record(self, kind: str, **fields) -> None:
+        if not self.enabled:
+            return
+        event = {"t": round(time.time(), 4), "kind": kind}
+        event.update(fields)
+        self.events.append(event)
+        self._m_events.inc()
+
+    def tick(self) -> None:
+        """One per-interval sample: deltas of the counters that explain
+        the landmark events around them (wire/queue pressure, progress).
+        Cheap — a handful of dict lookups over the live registry."""
+        if not self.enabled:
+            return
+        reg = self.registry
+        cur: Dict[str, float] = {
+            "wire_out_b": sum(
+                c.value for n, c in reg.counters.items()
+                if n.startswith("wire.out.bytes.")
+                or n.startswith("wire.out.retransmit_bytes.")
+            ),
+            "wire_in_b": sum(
+                c.value for n, c in reg.counters.items()
+                if n.startswith("wire.in.bytes.")
+            ),
+            "commits": float(
+                reg.counters.get(
+                    "consensus.committed_certificates", _NULL
+                ).value
+            ),
+            "batches": float(
+                reg.counters.get(
+                    "consensus.committed_batch_digests", _NULL
+                ).value
+            ),
+            "txs_sealed": float(
+                reg.counters.get("worker.txs_sealed", _NULL).value
+            ),
+        }
+        deltas = {
+            k: round(v - self._last_tick.get(k, 0.0), 1)
+            for k, v in cur.items()
+        }
+        self._last_tick = cur
+        gauges = {}
+        rnd = reg.gauges.get("primary.round")
+        if rnd is not None:
+            gauges["round"] = rnd.value
+        acks = reg.gauges.get("net.reliable.pending_acks")
+        if acks is not None:
+            gauges["pending_acks"] = acks.value
+        self.record("tick", d=deltas, **gauges)
+
+    async def run(self, interval_s: Optional[float] = None) -> None:
+        """The tick loop (node/main.py spawns one per process)."""
+        if interval_s is None:
+            interval_s = env_float("NARWHAL_FLIGHT_INTERVAL_S")
+        while True:
+            await asyncio.sleep(interval_s)
+            self.tick()
+
+    def snapshot(self) -> dict:
+        return {
+            "node": self.node_id,
+            "cap": self.events.maxlen,
+            "events": list(self.events),
+            "dumps": list(self.dumps),
+        }
+
+    def dump(self, reason: str) -> Optional[str]:
+        """Atomically write the current ring to ``NARWHAL_FLIGHT_DIR``
+        (no-op without a directory — the ring is still pullable via
+        /debug/flight).  Returns the path written, if any.  Never raises:
+        the recorder fires from teardown paths (SIGTERM, task death)
+        where a secondary failure must not mask the primary one."""
+        if not self.enabled:
+            return None
+        self.record("dump", reason=reason)
+        self._m_dumps.inc()
+        if not self.dir:
+            return None
+        self._seq += 1
+        # node_id embeds a base64 key prefix ('/' and '+' are legal
+        # there, not in a filename component) — sanitize for the path.
+        stem = "".join(
+            c if c.isalnum() or c in "._-" else "_"
+            for c in (self.node_id or f"pid{os.getpid()}")
+        )
+        path = os.path.join(
+            self.dir, f"flight-{stem}-{self._seq}-{reason}.json"
+        )
+        try:
+            os.makedirs(self.dir, exist_ok=True)
+            body = json.dumps(
+                {"reason": reason, "ts": time.time(), **self.snapshot()}
+            )
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                f.write(body)
+            os.replace(tmp, path)
+        except OSError:
+            log.exception("flight dump to %s failed", path)
+            return None
+        self.dumps.append(
+            {"reason": reason, "ts": round(time.time(), 3), "path": path}
+        )
+        log.warning("FLIGHT ring dumped (%s) -> %s", reason, path)
+        return path
+
+    def reset(self) -> None:
+        self.events.clear()
+        self.dumps.clear()
+        self._last_tick.clear()
+        self._seq = 0
+
+
 class _Null:
     """Shared no-op instrument for the stubbed registry (NARWHAL_METRICS=0).
     One class serves every instrument type: all mutators are no-ops and all
@@ -405,6 +573,9 @@ class Registry:
         # Per-(direction, message-type, peer) wire accounting; the
         # network senders/receiver feed it (see WireLedger).
         self.wire = WireLedger(self)
+        # Flight recorder: bounded ring of recent structured events,
+        # dumped on 503/SIGTERM/task-death (see FlightRecorder).
+        self.flight = FlightRecorder(self)
         if enabled:
             self.gauge_fn(
                 "metrics.trace_evictions", lambda: self.trace.evictions
@@ -468,6 +639,7 @@ class Registry:
             self.round_trace.entries.clear()
             self.round_trace.evictions = 0
         self.wire.reset()
+        self.flight.reset()
         # A monitor attached by a previous test would otherwise keep
         # reporting rule state over the zeroed instruments.
         self.health = None
@@ -987,6 +1159,7 @@ class HealthMonitor:
             else interval_s
         )
         self.evaluations = 0
+        self._was_ok = True
         self.events: Deque[dict] = collections.deque(maxlen=64)
         # (rule, subject) -> {breaches, oks, firing, since, detail}
         self._state: Dict[Tuple[str, str], dict] = {}
@@ -1086,6 +1259,14 @@ class HealthMonitor:
                         # stays bounded over churn.
                         self._state.pop(key, None)
         self.evaluations += 1
+        # The /healthz ok→failing edge IS the 503 transition: the moment
+        # the flight ring is most valuable (the events leading up to the
+        # first firing rule), so it dumps right here — before anything
+        # else can crash, restart, or truncate the node.
+        now_ok = self.ok()
+        if self._was_ok and not now_ok:
+            self.registry.flight.dump("healthz-503")
+        self._was_ok = now_ok
         return self.firing()
 
     def _transition(
@@ -1101,6 +1282,12 @@ class HealthMonitor:
             "detail": dict(st["detail"]),
         }
         self.events.append(event)
+        # Health transitions are flight-ring landmarks: the recorder's
+        # tick deltas around a FIRING edge are the post-mortem.
+        self.registry.flight.record(
+            "health", event=kind, rule=rule, subject=subject,
+            detail=dict(st["detail"]),
+        )
         msg = "HEALTH anomaly %s rule=%s%s detail=%s"
         sub = f" subject={subject}" if subject else ""
         if kind == "FIRING":
@@ -1201,6 +1388,16 @@ def wire_account(
     _REGISTRY.wire.account(direction, msg_type, peer, nbytes, retransmit)
 
 
+def flight() -> FlightRecorder:
+    return _REGISTRY.flight
+
+
+def flight_event(kind: str, **fields) -> None:
+    """Module-level convenience for the instrumented layers (one ring
+    append; no-op when the registry is stubbed)."""
+    _REGISTRY.flight.record(kind, **fields)
+
+
 # -- snapshot writer ----------------------------------------------------------
 
 class SnapshotWriter:
@@ -1281,7 +1478,10 @@ class MetricsServer:
     heavyweight stage-trace table — what the bench scraper polls at
     1 Hz), ``GET /healthz`` → 200/503 + the attached HealthMonitor's
     JSON (503 iff any rule is firing; 200 with ``status: unmonitored``
-    when no monitor is attached).  Anything else is 404.
+    when no monitor is attached), ``GET /debug/flight`` → the flight
+    recorder's live event ring (what the node was doing in its last
+    seconds — pulled by the bench scraper at quiesce).  Anything else
+    is 404.
 
     Hand-rolled over ``asyncio.start_server`` — the container bakes no
     http framework, and a scrape endpoint needs exactly one request per
@@ -1345,6 +1545,19 @@ class MetricsServer:
                     self.registry.snapshot(
                         include_trace=params.get("trace") != "0"
                     )
+                ).encode()
+                ctype = "application/json"
+                status = "200 OK"
+            elif path == "/debug/flight":
+                # The flight ring, live: what the node was doing in its
+                # last seconds, pullable without waiting for a dump
+                # trigger (the scraper reads this at quiesce).
+                body = json.dumps(
+                    {
+                        "ts": time.time(),
+                        "pid": os.getpid(),
+                        **self.registry.flight.snapshot(),
+                    }
                 ).encode()
                 ctype = "application/json"
                 status = "200 OK"

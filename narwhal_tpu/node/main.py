@@ -281,10 +281,24 @@ def main(argv=None) -> int:
         snapshot_task = None
         metrics_server = None
         health_task = None
+        flight_task = None
         # Loop-stall watchdog (NARWHAL_LOOP_WATCHDOG_MS): measured proof
         # that no callback holds this node's event loop — the runtime
         # half of the narwhal-lint invariant suite.
         loop_watchdog = install_loop_watchdog()
+        # Sampling profiler (NARWHAL_PROFILE_HZ, default ~67 Hz): all-
+        # thread stack samples folded into the `profile.*` series —
+        # general CPU attribution with no hand-placed probes.
+        from .. import profiling as _profiling
+
+        profiler_thread = _profiling.install_from_env()
+        # Flight recorder: the registry-attached ring records landmarks
+        # from everywhere; this process stamps its identity on it (dump
+        # filenames + /debug/flight) and runs the per-tick delta sampler.
+        flight = _metrics.registry().flight
+        flight.node_id = node_id
+        if flight.enabled:
+            flight_task = spawn(flight.run(), name="flight-ticks")
         if args.metrics_path:
             snapshot_task = spawn(
                 _metrics.SnapshotWriter(
@@ -357,6 +371,11 @@ def main(argv=None) -> int:
             logging.getLogger("narwhal.node").info(
                 "Shutdown signal received; tearing down"
             )
+            # SIGTERM is one of the flight recorder's dump triggers: the
+            # ring written here is the node's own account of its last
+            # seconds, independent of any scraper having been attached.
+            flight.record("shutdown", signal="SIGTERM")
+            flight.dump("sigterm")
         finally:
             await node.shutdown()
             if metrics_server is not None:
@@ -369,8 +388,13 @@ def main(argv=None) -> int:
                 # snapshot on disk covers the whole run.
                 snapshot_task.cancel()
                 await asyncio.gather(snapshot_task, return_exceptions=True)
+            if flight_task is not None:
+                flight_task.cancel()
+                await asyncio.gather(flight_task, return_exceptions=True)
             if loop_watchdog is not None:
                 await loop_watchdog.shutdown()
+            if profiler_thread is not None:
+                profiler_thread.shutdown()
 
     # NARWHAL_FAULTHANDLER_S=<seconds>: C-level watchdog that dumps every
     # thread's stack to stderr each interval — it fires even when the

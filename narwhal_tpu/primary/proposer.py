@@ -123,6 +123,7 @@ class Proposer:
         self._last_advance = now
         # Round-cadence trace: round `round`'s lifecycle ends here.
         self._rtrace.mark(str(round), "round_advance")
+        metrics.flight_event("round_advance", round=self.round)
         log.debug("Dag moved to round %d", self.round)
         self.last_parents = parents
         return True

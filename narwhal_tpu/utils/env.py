@@ -142,6 +142,34 @@ _VARS = [
         "`runtime.loop_stall_last`; 0/unset = off.",
     ),
     EnvVar(
+        "NARWHAL_PROFILE_HZ", "float", 67.0,
+        "Sampling-profiler frequency (all-thread stack samples/s into "
+        "the `profile.*` series, folded-stack + top-N tables in the "
+        "snapshot detail); `0` disables the sampler thread.",
+    ),
+    EnvVar(
+        "NARWHAL_FLIGHT", "flag", True,
+        "`0` stubs the flight recorder (event ring, tick deltas, and "
+        "the 503/SIGTERM/task-death dumps) without touching the rest "
+        "of the metrics plane.",
+    ),
+    EnvVar(
+        "NARWHAL_FLIGHT_CAP", "int", 512,
+        "Flight-recorder ring capacity (events kept; oldest evicted).",
+    ),
+    EnvVar(
+        "NARWHAL_FLIGHT_DIR", "str", None,
+        "Directory for atomic flight-ring dump files "
+        "(`flight-<node>-<n>-<reason>.json`) on the /healthz 503 "
+        "transition, SIGTERM, and unhandled task death; unset = no "
+        "file dumps (the ring stays pullable via `/debug/flight`).",
+    ),
+    EnvVar(
+        "NARWHAL_FLIGHT_INTERVAL_S", "float", 1.0,
+        "Seconds between flight-recorder `tick` events (per-tick "
+        "wire/commit/queue deltas).",
+    ),
+    EnvVar(
         "NARWHAL_FAULTHANDLER_S", "float", 0.0,
         "Arm `faulthandler.dump_traceback_later` every N seconds "
         "(C-level stack dumps that fire even with a wedged event loop); "

@@ -46,6 +46,12 @@ def _reap(task: asyncio.Task) -> None:
             task.get_name(),
             exc_info=exc,
         )
+        # A dead pipeline stage is exactly what the flight recorder
+        # exists for: record the death and dump the ring NOW, while the
+        # events leading up to it are still in the window.
+        flight = metrics.flight()
+        flight.record("task_death", task=task.get_name(), exc=repr(exc))
+        flight.dump("task-death")
 
 
 def spawn(coro: Coroutine, *, name: Optional[str] = None) -> asyncio.Task:

@@ -1,0 +1,230 @@
+"""Flight-recorder tests (narwhal_tpu/metrics.py FlightRecorder): the
+bounded ring, tick deltas, the three dump triggers (/healthz 503
+transition, unhandled task death — SIGTERM is exercised end-to-end by the
+bench harness), the /debug/flight endpoint, and the scraper pull."""
+
+import asyncio
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from narwhal_tpu import metrics  # noqa: E402
+from narwhal_tpu.metrics import (  # noqa: E402
+    FlightRecorder,
+    HealthMonitor,
+    HealthRule,
+    MetricsServer,
+    Registry,
+)
+from narwhal_tpu.utils.tasks import spawn  # noqa: E402
+
+
+def _ceiling_rule(limit=10, **kw):
+    def check(ctx):
+        v = ctx.gauge("t.val")
+        if v is not None and v > limit:
+            return {"": {"value": v, "threshold": limit}}
+        return {}
+
+    return HealthRule("ceiling", check, **kw)
+
+
+# -- the ring ------------------------------------------------------------------
+
+def test_ring_is_bounded_and_ordered():
+    reg = Registry()
+    fl = FlightRecorder(reg, cap=16)
+    for i in range(100):
+        fl.record("round_advance", round=i)
+    events = list(fl.events)
+    assert len(events) == 16
+    # FIFO eviction: only the newest 16 survive, in order.
+    assert [e["round"] for e in events] == list(range(84, 100))
+    assert reg.counters["flight.events"].value == 100
+    snap = fl.snapshot()
+    assert snap["cap"] == 16 and len(snap["events"]) == 16
+
+
+def test_ring_rides_in_registry_snapshot():
+    reg = Registry()
+    reg.flight.record("commit", certs=3, batches=7, round=4)
+    detail = reg.snapshot()["detail"]["flight.ring"]
+    assert detail["events"][-1]["kind"] == "commit"
+    assert detail["events"][-1]["certs"] == 3
+
+
+def test_tick_records_deltas_and_gauges():
+    reg = Registry()
+    reg.counter("consensus.committed_certificates").inc(5)
+    reg.counter("wire.out.bytes.header").inc(1000)
+    reg.gauge("primary.round").set(9)
+    fl = reg.flight
+    fl.tick()
+    reg.counter("consensus.committed_certificates").inc(2)
+    reg.counter("wire.out.bytes.header").inc(500)
+    fl.tick()
+    first, second = [e for e in fl.events if e["kind"] == "tick"]
+    # First tick measures from zero; the second measures the delta.
+    assert first["d"]["commits"] == 5 and first["d"]["wire_out_b"] == 1000
+    assert second["d"]["commits"] == 2 and second["d"]["wire_out_b"] == 500
+    assert second["round"] == 9
+
+
+def test_disabled_recorder_is_inert(tmp_path, monkeypatch):
+    monkeypatch.setenv("NARWHAL_FLIGHT", "0")
+    reg = Registry()
+    reg.flight.dir = str(tmp_path)
+    reg.flight.record("commit", certs=1)
+    reg.flight.tick()
+    assert reg.flight.dump("healthz-503") is None
+    assert list(reg.flight.events) == []
+    assert "flight.events" not in reg.counters
+    assert "flight.ring" not in reg.snapshot()["detail"]
+    assert list(tmp_path.iterdir()) == []
+
+
+# -- dump triggers -------------------------------------------------------------
+
+def test_flight_dump_fires_on_induced_503_transition(tmp_path):
+    """The ISSUE acceptance pair with test_health's 200↔503 test: the
+    moment the monitor's verdict crosses ok→failing (what /healthz
+    serves as 503), the ring must land on disk — with the events that
+    led up to the anomaly inside it."""
+    reg = Registry()
+    reg.flight.dir = str(tmp_path)
+    g = reg.gauge("t.val")
+    mon = HealthMonitor(
+        reg, rules=[_ceiling_rule(for_intervals=2, clear_intervals=2)],
+        interval_s=1.0,
+    )
+    reg.health = mon
+    reg.flight.record("round_advance", round=3)
+    mon.evaluate(0.0)
+    assert list(tmp_path.glob("flight-*.json")) == []
+    g.set(99)
+    mon.evaluate(1.0)  # first breach: hysteresis holds, no dump yet
+    assert list(tmp_path.glob("flight-*.json")) == []
+    mon.evaluate(2.0)  # second breach: FIRING -> 503 transition -> dump
+    dumps = list(tmp_path.glob("flight-*-healthz-503.json"))
+    assert len(dumps) == 1
+    body = json.loads(dumps[0].read_text())
+    assert body["reason"] == "healthz-503"
+    kinds = [e["kind"] for e in body["events"]]
+    assert "round_advance" in kinds  # pre-anomaly history was captured
+    health = [e for e in body["events"] if e["kind"] == "health"]
+    assert health and health[-1]["rule"] == "ceiling"
+    assert health[-1]["event"] == "FIRING"
+    # Staying failing must not re-dump (the trigger is the TRANSITION) …
+    g.set(100)
+    mon.evaluate(3.0)
+    assert len(list(tmp_path.glob("flight-*.json"))) == 1
+    # … and a clear + re-fire is a new transition, hence a new dump.
+    g.set(0)
+    mon.evaluate(4.0)
+    mon.evaluate(5.0)
+    g.set(99)
+    mon.evaluate(6.0)
+    mon.evaluate(7.0)
+    assert len(list(tmp_path.glob("flight-*.json"))) == 2
+    assert reg.counters["flight.dumps"].value == 2
+
+
+def test_flight_dump_fires_on_unhandled_task_death(tmp_path):
+    reg = metrics.registry()
+    reg.reset()
+    # registry() is the module singleton spawn() records into; point its
+    # recorder at a scratch dir for the dump assertion.
+    reg.flight.dir = str(tmp_path)
+
+    async def go():
+        async def doomed():
+            raise RuntimeError("boom")
+
+        task = spawn(doomed(), name="doomed-stage")
+        await asyncio.gather(task, return_exceptions=True)
+        await asyncio.sleep(0)  # let the done-callback run
+
+    asyncio.run(asyncio.wait_for(go(), 10))
+    reg.flight.dir = None
+    deaths = [e for e in reg.flight.events if e["kind"] == "task_death"]
+    assert deaths and deaths[-1]["task"] == "doomed-stage"
+    assert "boom" in deaths[-1]["exc"]
+    dumps = list(tmp_path.glob("flight-*-task-death.json"))
+    assert len(dumps) == 1
+    body = json.loads(dumps[0].read_text())
+    assert any(e["kind"] == "task_death" for e in body["events"])
+
+
+def test_dump_without_dir_is_ring_only():
+    reg = Registry()
+    assert reg.flight.dir is None
+    assert reg.flight.dump("healthz-503") is None
+    # The dump marker still lands in the ring (and the counter).
+    assert [e["kind"] for e in reg.flight.events] == ["dump"]
+    assert reg.counters["flight.dumps"].value == 1
+
+
+# -- /debug/flight endpoint ----------------------------------------------------
+
+async def _fetch(port, target):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    writer.write(f"GET {target} HTTP/1.1\r\nHost: x\r\n\r\n".encode())
+    await writer.drain()
+    data = await reader.read()
+    writer.close()
+    return data
+
+
+def test_debug_flight_endpoint_serves_the_ring():
+    reg = Registry()
+    reg.flight.node_id = "primary-test"
+    reg.flight.record("commit", certs=2, batches=5, round=7)
+    reg.flight.record("loop_stall", stall_s=0.25)
+
+    async def go():
+        server = await MetricsServer.spawn(reg, 0, host="127.0.0.1")
+        try:
+            resp = await _fetch(server.port, "/debug/flight")
+            assert b"200 OK" in resp
+            body = json.loads(resp.split(b"\r\n\r\n", 1)[1])
+            assert body["node"] == "primary-test"
+            assert [e["kind"] for e in body["events"]] == [
+                "commit", "loop_stall",
+            ]
+            assert body["events"][0]["certs"] == 2
+        finally:
+            await server.shutdown()
+
+    asyncio.run(asyncio.wait_for(go(), 15))
+
+
+def test_scraper_flight_all_pulls_rings():
+    """The quiesce-time pull both harnesses embed as the bench JSON
+    `flight` section — against a live endpoint and a dead target."""
+    from benchmark.scraper import Scraper
+
+    reg = Registry()
+    reg.flight.record("commit", certs=1, batches=2, round=3)
+    result = {}
+
+    async def go():
+        server = await MetricsServer.spawn(reg, 0, host="127.0.0.1")
+        try:
+            scraper = Scraper(
+                [("node-0", "127.0.0.1", server.port),
+                 ("node-gone", "127.0.0.1", 1)],
+                interval_s=0.05,
+            )
+            result.update(
+                await asyncio.get_running_loop().run_in_executor(
+                    None, scraper.flight_all
+                )
+            )
+        finally:
+            await server.shutdown()
+
+    asyncio.run(asyncio.wait_for(go(), 15))
+    assert result["node-gone"] is None
+    assert [e["kind"] for e in result["node-0"]["events"]] == ["commit"]

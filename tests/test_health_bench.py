@@ -10,6 +10,7 @@ test to drive benchmark/local_bench.py end to end under pytest."""
 
 import json
 import os
+import shutil
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -34,6 +35,10 @@ def _run_clean_bench(tmp_path):
             workdir=str(tmp_path / f"bench-{attempt}"),
             quiet=True,
             scrape_interval=1.0,
+            # ISSUE 11: every clean run also exports the whole committee
+            # as ONE Perfetto-loadable Chrome trace — round-tripped and
+            # asserted below (8 process rows, cross-process digest flows).
+            trace_out=str(tmp_path / f"bench-{attempt}" / "trace.json"),
             # The ISSUE 9 loop-watchdog smoke arm: every node arms the
             # event-loop stall watchdog so a clean run MEASURES (not
             # infers) that no callback held its loop — the series lands
@@ -57,7 +62,7 @@ def _run_clean_bench(tmp_path):
             )
         )
         if ok or attempt == 2:
-            return result
+            return result, str(tmp_path / f"bench-{attempt}")
         print(
             f"window {attempt} failed (errors={result.errors!r}); "
             "scraped timeline dump:",
@@ -74,16 +79,24 @@ def _run_clean_bench(tmp_path):
 
 
 def test_clean_local_bench_has_timeline_and_no_firing_rules(tmp_path):
-    result = _run_clean_bench(tmp_path)
+    result, workdir = _run_clean_bench(tmp_path)
 
-    # CI artifact: the committee timeline from the bench run, uploaded
-    # by the workflow (same NARWHAL_METRICS_DUMP convention as the
-    # metrics-smoke snapshot).
+    # CI artifacts: the committee timeline, the exported Perfetto trace,
+    # and the quiesce flight rings from the bench run, uploaded by the
+    # workflow (same NARWHAL_METRICS_DUMP convention as the metrics-smoke
+    # snapshot; `make trace-smoke` drives this test for exactly these).
     dump_dir = os.environ.get("NARWHAL_METRICS_DUMP")
     if dump_dir:
         os.makedirs(dump_dir, exist_ok=True)
         with open(os.path.join(dump_dir, "timeline.json"), "w") as f:
             json.dump(result.timeline, f, indent=1)
+        trace_src = os.path.join(workdir, "trace.json")
+        if os.path.exists(trace_src):
+            shutil.copyfile(
+                trace_src, os.path.join(dump_dir, "trace-smoke.json")
+            )
+        with open(os.path.join(dump_dir, "flight-rings.json"), "w") as f:
+            json.dump(result.flight, f, indent=1)
 
     # The run itself is clean: parses, commits, cross-validates, and —
     # new gate — no node's /healthz reported a firing rule at quiesce
@@ -173,3 +186,67 @@ def test_clean_local_bench_has_timeline_and_no_firing_rules(tmp_path):
     check = crypto["protocol_check"]
     assert abs(check["votes"]["ratio"] - 1.0) <= 0.05, check
     assert abs(check["certificates"]["ratio"] - 1.0) <= 0.05, check
+
+    # -- flight recorder at quiesce (ISSUE 11 satellite) ---------------------
+    # Every node's /debug/flight ring rides in the bench JSON, so even a
+    # clean run carries its last-seconds event history.  Primaries must
+    # show protocol landmarks plus the per-tick delta samples.
+    expected = {f"primary-{i}" for i in range(4)} | {
+        f"worker-{i}-0" for i in range(4)
+    }
+    flight = result.flight
+    assert set(flight) == expected, sorted(flight)
+    for name in expected:
+        ring = flight[name]
+        assert ring is not None and ring["events"], name
+    for i in range(4):
+        kinds = {e["kind"] for e in flight[f"primary-{i}"]["events"]}
+        assert "round_advance" in kinds, (i, sorted(kinds))
+        assert "commit" in kinds, (i, sorted(kinds))
+        assert "tick" in kinds, (i, sorted(kinds))
+
+    # -- unified Perfetto trace export (ISSUE 11 tentpole) -------------------
+    # One --trace-out command round-trips the run into schema-valid
+    # Chrome trace JSON: all 8 process rows and ≥1 cross-process digest
+    # flow (seal on a worker row → commit on a primary row).
+    with open(os.path.join(workdir, "trace.json")) as f:
+        trace = json.load(f)
+    assert trace["traceEvents"], "trace is empty"
+    for ev in trace["traceEvents"]:
+        assert "ph" in ev and "pid" in ev
+        if ev["ph"] == "X":
+            assert ev["dur"] >= 1 and ev["ts"] >= 0
+    names = trace["metadata"]["node_pids"]
+    assert set(names) == expected, sorted(names)
+    flows = {}
+    for ev in trace["traceEvents"]:
+        if ev["ph"] in "stf":
+            flows.setdefault(ev["id"], []).append(ev)
+    cross = [
+        chain for chain in flows.values()
+        if len({ev["pid"] for ev in chain}) >= 2
+        and chain[0]["ph"] == "s"
+        and chain[-1]["ph"] == "f"
+    ]
+    assert cross, f"no cross-process digest flow among {len(flows)} flows"
+    worker_pids = {names[n] for n in names if n.startswith("worker")}
+    assert any(c[0]["pid"] in worker_pids for c in cross), (
+        "no flow starts at a worker's seal slice"
+    )
+
+    # -- sampling profiler, always on (ISSUE 11 tentpole) --------------------
+    # Default NARWHAL_PROFILE_HZ (~67) armed the profiler in every node:
+    # the trace carries sampled-CPU slices and every snapshot-backed row
+    # must have accumulated samples (asserted via the cpu track the
+    # exporter builds from `profile.timeline`).
+    cpu_slices = [
+        ev for ev in trace["traceEvents"]
+        if ev["ph"] == "X" and ev.get("cat") == "cpu"
+    ]
+    assert cpu_slices, "no sampled-CPU slices in the trace"
+    # Primaries burn their loop in protocol work; each primary row shows
+    # sampled CPU (a worker on a starved host may idle, so only gate the
+    # primaries).
+    cpu_pids = {ev["pid"] for ev in cpu_slices}
+    for i in range(4):
+        assert names[f"primary-{i}"] in cpu_pids, f"primary-{i} has no cpu"

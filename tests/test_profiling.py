@@ -1,0 +1,145 @@
+"""Sampling-profiler tests (narwhal_tpu/profiling.py): samples accumulate
+against a busy thread with the busy frame dominating self-time, folded
+output is flamegraph-shaped, the main-thread leaf timeline run-length
+encodes, and a disabled profiler leaves zero series behind."""
+
+import os
+import re
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from narwhal_tpu import metrics, profiling  # noqa: E402
+from narwhal_tpu.metrics import Registry  # noqa: E402
+from narwhal_tpu.profiling import SamplingProfiler  # noqa: E402
+
+
+def _burn_cycles_for_profiler(stop: threading.Event) -> None:
+    """Deliberately-named busy loop the sampler must attribute.  The
+    stop check runs once per big inner batch so the samples land in THIS
+    frame, not in Event.is_set."""
+    x = 1
+    while not stop.is_set():
+        for _ in range(50_000):
+            x = (x * 31 + 7) % 1000003
+
+
+def test_samples_accumulate_on_a_busy_thread():
+    reg = Registry()
+    prof = SamplingProfiler(hz=250, reg=reg)
+    stop = threading.Event()
+    t = threading.Thread(
+        target=_burn_cycles_for_profiler, args=(stop,), name="busy-worker"
+    )
+    t.start()
+    park = threading.Event()  # main-thread poll leaf = Event.wait (idle)
+    try:
+        prof.start()
+        deadline = time.time() + 5.0
+        while (
+            reg.counters["profile.samples"].value < 30
+            and time.time() < deadline
+        ):
+            park.wait(0.02)
+    finally:
+        prof.shutdown()
+        stop.set()
+        t.join()
+
+    assert reg.counters["profile.samples"].value >= 30
+    assert reg.gauges["profile.hz"].value == 250
+
+    # The busy function dominates self-time among non-idle frames.
+    top = prof.top_table()
+    assert top, "top table empty despite samples"
+    busy_rows = [
+        r for r in top if "_burn_cycles_for_profiler" in r["frame"]
+    ]
+    assert busy_rows, f"busy frame missing from top table: {top[:5]}"
+    assert busy_rows[0]["self"] > 0
+    assert busy_rows[0]["total"] >= busy_rows[0]["self"]
+    assert busy_rows[0] == max(top, key=lambda r: r["self"]), (
+        "busy loop is not the dominant self-time frame: " f"{top[:5]}"
+    )
+
+    # Folded output: `thread;frame;…;leaf count` lines, busy stack present.
+    folded = prof.folded()
+    assert folded
+    for line in folded.splitlines():
+        assert re.fullmatch(r"[^ ]+( [^ ]+)* \d+", line), line
+    assert any(
+        "busy-worker;" in line and "_burn_cycles_for_profiler" in line
+        for line in folded.splitlines()
+    ), folded[:500]
+
+    # The registry snapshot carries every profile.* surface.
+    snap = reg.snapshot()
+    assert snap["counters"]["profile.samples"] >= 30
+    assert snap["detail"]["profile.top"]
+    assert isinstance(snap["detail"]["profile.folded"], str)
+
+
+def test_main_thread_timeline_run_length_encodes():
+    reg = Registry()
+    prof = SamplingProfiler(hz=100, reg=reg)
+    # Drive sampling synchronously (no daemon thread): the main thread —
+    # this test — is mid-call, so every tick appends/extends a run.
+    for _ in range(10):
+        prof.sample_once()
+    runs = reg.snapshot()["detail"]["profile.timeline"]
+    assert runs, "no main-thread leaf runs recorded"
+    for start, end, samples, label in runs:
+        assert end >= start and samples >= 1 and isinstance(label, str)
+    # 10 identical-leaf ticks collapse into far fewer runs.
+    assert sum(r[2] for r in runs) == 10
+    assert len(runs) < 10
+
+
+def test_idle_leaves_counted_but_excluded_from_self_time():
+    reg = Registry()
+    prof = SamplingProfiler(hz=100, reg=reg)
+    waiter_parked = threading.Event()
+    release = threading.Event()
+
+    def waiter():
+        waiter_parked.set()
+        release.wait(10)
+
+    t = threading.Thread(target=waiter, name="parked")
+    t.start()
+    try:
+        assert waiter_parked.wait(5)
+        time.sleep(0.05)  # let the waiter actually enter Event.wait
+        for _ in range(5):
+            prof.sample_once()
+    finally:
+        release.set()
+        t.join()
+    assert reg.counters["profile.idle_samples"].value > 0
+    # The wait frame appears in the folded stacks (wall-clock truth) …
+    assert "waiter" in prof.folded()
+    # … but never as a self-time row (CPU attribution).
+    assert not any("threading.py:wait" == r["frame"] for r in prof.top_table())
+
+
+def test_disabled_profiler_leaves_zero_series(monkeypatch):
+    monkeypatch.setenv("NARWHAL_PROFILE_HZ", "0")
+    assert profiling.install_from_env() is None
+    # A fresh registry never touched by a profiler carries no profile.*
+    # series at all — "zero series when disabled".
+    reg = Registry()
+    snap = reg.snapshot()
+    assert not any(k.startswith("profile.") for k in snap["counters"])
+    assert not any(k.startswith("profile.") for k in snap["gauges"])
+    assert not any(k.startswith("profile.") for k in snap["detail"])
+
+
+def test_install_from_env_declines_on_stubbed_registry(monkeypatch):
+    monkeypatch.setenv("NARWHAL_PROFILE_HZ", "100")
+    monkeypatch.setattr(metrics.registry(), "enabled", False)
+    try:
+        assert profiling.install_from_env() is None
+    finally:
+        monkeypatch.undo()

@@ -1,0 +1,292 @@
+"""Trace-exporter tests (benchmark/trace_export.py): a synthetic 4-node
+committee dump round-trips into schema-valid Chrome trace JSON — process
+row per node, stage/round slices, cross-process digest flows, flight/
+health instants, profiler CPU slices — and logs_merge --trace interleaves
+merged log lines onto the same timeline.  (The real-bench round-trip over
+live node snapshots is asserted by tests/test_health_bench.py.)"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmark import logs_merge, trace_export  # noqa: E402
+from narwhal_tpu.metrics import ROUND_STAGES, STAGES  # noqa: E402
+
+T0 = 1_700_000_000.0
+DIGEST = "ab" * 32
+
+
+def _committee_snapshots():
+    """A minimal-but-complete 4-node × (primary + worker) committee dump:
+    one digest sealed on worker-0-0, proposed/certified on primary-0,
+    committed on every primary — the real snapshot shape end to end."""
+    snaps = []
+    for i in range(4):
+        ptrace = {
+            "cert_inserted": T0 + 0.06,
+            "commit_trigger": T0 + 0.07,
+            "walk_done": T0 + 0.071,
+            "commit": T0 + 0.08 + i * 0.001,
+        }
+        if i == 0:
+            ptrace.update({
+                "digest_at_primary": T0 + 0.02,
+                "header": T0 + 0.03,
+                "cert": T0 + 0.05,
+            })
+        snaps.append((f"primary-{i}", {
+            "enabled": True,
+            "trace": {DIGEST: ptrace},
+            "round_trace": {
+                "3": {
+                    s: T0 + 0.02 + 0.005 * j
+                    for j, s in enumerate(ROUND_STAGES)
+                }
+            },
+            "detail": {
+                "flight.ring": {"events": [
+                    {"t": T0 + 0.055, "kind": "round_advance", "round": 4},
+                    {"t": T0 + 0.08, "kind": "commit", "certs": 1,
+                     "batches": 1, "round": 2, "walk_ms": 1.0},
+                    {"t": T0 + 0.5, "kind": "tick",
+                     "d": {"wire_out_b": 1234.0, "commits": 1.0},
+                     "round": 4},
+                ]},
+                "profile.timeline": [
+                    [T0, T0 + 0.4, 27, "_ed25519_py.py:verify"],
+                ],
+            },
+            "health": {"events": [
+                {"t": T0 + 0.3, "rule": "commit_stall", "event": "FIRING",
+                 "subject": "", "detail": {"seconds_without_commit": 11}},
+            ]} if i == 1 else {},
+        }))
+        snaps.append((f"worker-{i}-0", {
+            "enabled": True,
+            "trace": (
+                {DIGEST: {"seal": T0, "quorum": T0 + 0.01, "bytes": 400}}
+                if i == 0
+                else {}
+            ),
+            "round_trace": {},
+            "detail": {},
+        }))
+    return snaps
+
+
+def _validate_schema(trace):
+    assert set(trace) >= {"traceEvents", "displayTimeUnit", "metadata"}
+    for ev in trace["traceEvents"]:
+        assert {"ph", "pid", "ts"} <= set(ev) or ev["ph"] == "M", ev
+        assert isinstance(ev["pid"], int)
+        if ev["ph"] == "X":
+            assert ev["dur"] >= 1 and ev["ts"] >= 0, ev
+        if ev["ph"] in "stf":
+            assert "id" in ev, ev
+    # The whole document must be JSON-serializable as-is.
+    json.dumps(trace)
+
+
+def test_four_node_dump_round_trips_with_rows_and_flows():
+    trace = trace_export.build_trace(_committee_snapshots())
+    _validate_schema(trace)
+
+    # All 8 process rows, named, primaries sorted first.
+    names = {
+        ev["args"]["name"]: ev["pid"]
+        for ev in trace["traceEvents"]
+        if ev["ph"] == "M" and ev["name"] == "process_name"
+    }
+    assert set(names) == (
+        {f"primary-{i}" for i in range(4)}
+        | {f"worker-{i}-0" for i in range(4)}
+    )
+    assert names == trace["metadata"]["node_pids"]
+    assert all(names[f"primary-{i}"] < names["worker-0-0"] for i in range(4))
+
+    # ≥1 cross-process digest flow: s on the sealing worker, f elsewhere.
+    flows = [ev for ev in trace["traceEvents"] if ev["ph"] in "stf"]
+    by_id = {}
+    for ev in flows:
+        by_id.setdefault(ev["id"], []).append(ev)
+    assert trace["metadata"]["flows_emitted"] == 1
+    chain = by_id[DIGEST[:16]]
+    phases = [ev["ph"] for ev in chain]
+    assert phases[0] == "s" and phases[-1] == "f"
+    assert all(p == "t" for p in phases[1:-1])
+    assert chain[0]["pid"] == names["worker-0-0"]  # starts at the seal
+    assert chain[-1]["pid"] != chain[0]["pid"]  # ends across processes
+    # Time-ordered within the chain, ts rebased to the trace origin.
+    tss = [ev["ts"] for ev in chain]
+    assert tss == sorted(tss) and tss[0] == 0
+
+    # Stage leg slices exist on both planes of authority 0.
+    slices = [ev for ev in trace["traceEvents"] if ev["ph"] == "X"]
+    leg_names = {ev["name"] for ev in slices}
+    assert "seal→quorum" in leg_names
+    assert "digest_at_primary→header" in leg_names
+    assert "walk_done→commit" in leg_names
+
+    # Round slices: the parent span and its cadence legs.
+    assert "round 3" in leg_names
+    assert f"{ROUND_STAGES[0]}→{ROUND_STAGES[1]}" in leg_names
+
+    # Flight landmarks became instants; ticks became counter samples.
+    instants = [ev for ev in trace["traceEvents"] if ev["ph"] == "i"]
+    assert any(ev["name"] == "flight:commit" for ev in instants)
+    counters = [ev for ev in trace["traceEvents"] if ev["ph"] == "C"]
+    assert any(ev["args"].get("wire_out_b") == 1234.0 for ev in counters)
+
+    # Health transition instant (node 1's snapshot events).
+    assert any(
+        ev["name"] == "health:commit_stall:FIRING"
+        and ev["pid"] == names["primary-1"]
+        for ev in instants
+    )
+
+    # Profiler CPU track: the verify run as a slice on tid 4.
+    cpu = [ev for ev in slices if ev["tid"] == trace_export.TID_CPU]
+    assert cpu and cpu[0]["name"] == "_ed25519_py.py:verify"
+    assert cpu[0]["args"]["samples"] == 27
+
+
+def test_flow_cap_samples_not_truncates():
+    snaps = _committee_snapshots()
+    # Mint 40 committed digests across worker-0-0 and primary-0.
+    names = {n: s for n, s in snaps}
+    for k in range(40):
+        d = f"{k:02x}" * 32
+        names["worker-0-0"]["trace"][d] = {
+            "seal": T0 + k, "quorum": T0 + k + 0.01,
+        }
+        names["primary-0"]["trace"][d] = {
+            "header": T0 + k + 0.02, "cert": T0 + k + 0.03,
+            "commit": T0 + k + 0.05,
+        }
+    trace = trace_export.build_trace(list(names.items()), max_flows=10)
+    md = trace["metadata"]
+    assert md["flows_emitted"] == 10
+    assert md["flows_total"] >= 40
+    assert md["flows_dropped"] == md["flows_total"] - 10
+    _validate_schema(trace)
+
+
+def test_newest_flight_ring_wins():
+    """Scraped-at-quiesce vs snapshot copies of the same bounded ring:
+    whichever carries the newest event is the one exported — the scrape
+    wins only for a node whose snapshot went stale (SIGKILL mid-run),
+    never in the normal scrape→SIGTERM→final-flush order where the
+    snapshot holds the shutdown tail."""
+
+    def flight_names(trace, node):
+        pid = trace["metadata"]["node_pids"][node]
+        return [
+            ev["name"] for ev in trace["traceEvents"]
+            if ev["ph"] == "i" and ev["pid"] == pid
+            and ev.get("cat") == "flight"
+        ]
+
+    fresh = {"events": [
+        {"t": T0 + 1.0, "kind": "shutdown", "signal": "SIGTERM"},
+    ]}
+    trace = trace_export.build_trace(
+        _committee_snapshots(), flight={"primary-0": fresh}
+    )
+    assert flight_names(trace, "primary-0") == ["flight:shutdown"]
+
+    # An OLDER scraped ring must NOT displace the snapshot's superset.
+    stale = {"events": [{"t": T0 - 5.0, "kind": "round_advance"}]}
+    trace = trace_export.build_trace(
+        _committee_snapshots(), flight={"primary-0": stale}
+    )
+    assert flight_names(trace, "primary-0") == [
+        "flight:round_advance", "flight:commit",
+    ]
+
+
+def test_timeline_adds_rate_counters_and_events():
+    timeline = {
+        "nodes": {"primary-2": [
+            {"t": T0 + 1, "commit_rate_per_s": 3.5, "pending_acks": 7},
+        ]},
+        "events": [
+            {"node": "primary-3", "t": T0 + 2, "rule": "peer_unreachable",
+             "event": "FIRING", "subject": "10.0.0.1:7001", "detail": {}},
+        ],
+    }
+    trace = trace_export.build_trace(
+        _committee_snapshots(), timeline=timeline
+    )
+    names = trace["metadata"]["node_pids"]
+    assert any(
+        ev["ph"] == "C" and ev["pid"] == names["primary-2"]
+        and ev["args"].get("commit_rate_per_s") == 3.5
+        for ev in trace["traceEvents"]
+    )
+    assert any(
+        ev["ph"] == "i" and ev["pid"] == names["primary-3"]
+        and ev["name"] == "health:peer_unreachable:FIRING"
+        for ev in trace["traceEvents"]
+    )
+
+
+def test_export_writes_atomically_and_workdir_loads(tmp_path):
+    workdir = tmp_path / "bench"
+    workdir.mkdir()
+    for name, snap in _committee_snapshots():
+        (workdir / f"metrics-{name}.json").write_text(json.dumps(snap))
+    (workdir / "timeline.json").write_text(json.dumps({"nodes": {}}))
+    snaps, timeline = trace_export.load_workdir(str(workdir))
+    assert len(snaps) == 8 and timeline == {"nodes": {}}
+    out = tmp_path / "trace.json"
+    trace_export.export(snaps, str(out), timeline=timeline, quiet=True)
+    trace = json.loads(out.read_text())
+    _validate_schema(trace)
+    assert len(trace["metadata"]["node_pids"]) == 8
+
+
+def test_logs_merge_injects_instants_onto_node_rows(tmp_path):
+    out = tmp_path / "trace.json"
+    trace_export.export(
+        _committee_snapshots(), str(out), quiet=True
+    )
+    # Two node streams + a client stream: the bench-workdir shape.  The
+    # primary's records carry the RUNTIME node id (role-keyprefix, what
+    # --log-json actually stamps) and must map onto the trace row via
+    # the source FILE stem; the worker's carry a row-matching id (maps
+    # directly); the client's match neither and are dropped counted.
+    log_a = tmp_path / "primary-0.log"
+    log_a.write_text(
+        json.dumps({"ts": T0 + 0.04, "level": "INFO",
+                    "logger": "narwhal.primary", "msg": "Created H3",
+                    "node": "primary-ab12cd34"}) + "\n"
+    )
+    log_b = tmp_path / "worker-0-0.log"
+    log_b.write_text(
+        json.dumps({"ts": T0 + 0.005, "level": "WARNING",
+                    "logger": "narwhal.worker", "msg": "QueueFull",
+                    "node": "worker-0-0"}) + "\n"
+    )
+    log_c = tmp_path / "client-9.log"
+    log_c.write_text(
+        json.dumps({"ts": T0 + 0.006, "level": "INFO",
+                    "msg": "from nowhere", "node": "client-9"}) + "\n"
+    )
+    rc = logs_merge.main(
+        [str(log_a), str(log_b), str(log_c), "--trace", str(out)]
+    )
+    assert rc == 0
+    trace = json.loads(out.read_text())
+    names = trace["metadata"]["node_pids"]
+    logs = [
+        ev for ev in trace["traceEvents"] if ev.get("cat") == "log"
+    ]
+    assert len(logs) == 2
+    by_pid = {ev["pid"]: ev for ev in logs}
+    assert by_pid[names["primary-0"]]["args"]["msg"] == "Created H3"
+    assert by_pid[names["worker-0-0"]]["name"] == "log:WARNING"
+    assert trace["metadata"]["logs_injected"] == 2
+    assert trace["metadata"]["logs_dropped"] == 1
+    _validate_schema(trace)
