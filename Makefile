@@ -12,7 +12,7 @@ PYTHON ?= python
 
 .PHONY: check native lint lint-invariants test test-ci metrics-smoke \
 	trace-smoke fault-smoke fault-fuzz-smoke trajectory race-explore \
-	sanitize bench clean
+	sim-smoke sanitize bench clean
 
 check: native lint test
 
@@ -119,6 +119,22 @@ race-explore:
 	JAX_PLATFORMS=cpu $(PYTHON) benchmark/race_explore.py \
 		--seeds 16 --committee-seeds 4 \
 		--artifact .ci-artifacts/race-explore.json
+
+# Deterministic committee-at-scale simulation sweep (ISSUE 12): ≥200
+# fuzzed (seed × fault × committee-size) points — sizes 4/7/10/20, at
+# least one N=20 — run single-process on the virtual clock and judged
+# by the three-verdict engine (golden-replay safety, virtual-time
+# liveness, health-rule detection), plus per-size clean controls (zero
+# firings), a same-seed bit-reproducibility pin, the planted-mutation
+# honesty arms (RacyConsensus + stripped-expectation Byzantine), and
+# the N=20/60-virtual-second acceptance arm whose wall-clock
+# compression ratio is measured and gated.  Failing points dump
+# replayable (seed, spec) repro files beside the artifact; replay one
+# with `python benchmark/sim_bench.py --replay <file>`.
+sim-smoke:
+	mkdir -p .ci-artifacts
+	JAX_PLATFORMS=cpu $(PYTHON) benchmark/sim_bench.py \
+		--points 200 --artifact .ci-artifacts/sim-smoke.json --quiet
 
 # Asyncio sanitizer tier (ISSUE 10): the fast concurrency-sensitive
 # tier-1 subset under `python -X dev` — asyncio debug mode with the

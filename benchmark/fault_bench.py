@@ -79,74 +79,21 @@ _RESTART_SETTLE_S = 10.0
 _HEAL_SETTLE_S = 3.0
 
 
-def _wan_addresses(committee, name) -> List[str]:
-    """Every address of ``name`` that OTHER authorities dial over the
-    emulated WAN (intra-authority LAN addresses excluded)."""
-    auth = committee.authorities[name]
-    out = [auth.primary.primary_to_primary]
-    for w in auth.workers.values():
-        out.append(w.worker_to_worker)
-    return out
-
-
 def compile_netem(
     scenario: FaultScenario, committee, keypairs, start_ts: float
 ) -> Optional[dict]:
     """Resolve the scenario's ``wan`` plane into the per-node config file
-    narwhal_tpu/faults/netem.py loads (addresses instead of indices)."""
-    wan = scenario.wan
-    if wan is None:
+    narwhal_tpu/faults/netem.py loads (addresses instead of indices).
+    The resolution itself is the shared
+    ``faults/netem.py::resolve_wan_plane`` — the same table the sim
+    transport consumes — wrapped in this runner's file envelope."""
+    if scenario.wan is None:
         return None
-    names = [kp.name for kp in keypairs]
-    nodes: Dict[str, dict] = {}
+    from narwhal_tpu.faults.netem import resolve_wan_plane
 
-    def node_entry(label: str) -> dict:
-        return nodes.setdefault(label, {"rules": [], "partitions": []})
-
-    pair_shapes = {
-        (p.src, p.dst): p for p in wan.pairs
-    }
-    for i in range(scenario.nodes):
-        labels = [f"primary-{i}"] + [
-            f"worker-{i}-{wid}" for wid in range(scenario.workers)
-        ]
-        for j in range(scenario.nodes):
-            if j == i:
-                continue  # intra-authority traffic stays LAN-fast
-            p = pair_shapes.get((i, j))
-            shape = {
-                "latency_ms": p.latency_ms if p else wan.latency_ms,
-                "jitter_ms": p.jitter_ms if p else wan.jitter_ms,
-                "loss": p.loss if p else wan.loss,
-            }
-            if not any(shape.values()):
-                continue
-            for dst in _wan_addresses(committee, names[j]):
-                for label in labels:
-                    node_entry(label)["rules"].append(
-                        dict(shape, dst=dst)
-                    )
-        for part in wan.partitions:
-            group = set(part.group)
-            if i in group:
-                cut = [j for j in range(scenario.nodes) if j not in group]
-            else:
-                cut = [j for j in group]
-            peers = [
-                a
-                for j in cut
-                for a in _wan_addresses(committee, names[j])
-            ]
-            if not peers:
-                continue
-            for label in labels:
-                node_entry(label)["partitions"].append(
-                    {
-                        "peers": peers,
-                        "from_s": part.from_s,
-                        "until_s": part.until_s,
-                    }
-                )
+    nodes = resolve_wan_plane(
+        scenario, committee, [kp.name for kp in keypairs]
+    )
     return {"seed": scenario.seed, "start_ts": start_ts, "nodes": nodes}
 
 
@@ -699,7 +646,11 @@ def main() -> int:
         from narwhal_tpu.faults.spec import parse_scenario
 
         for seed in args.fuzz_seed:
-            obj = generate(seed)
+            # Committee-size pool pinned to N=4: the socketed runner
+            # pays 3 real processes per authority and its detection
+            # contracts were timed on a 4-node host; the full size pool
+            # (7/10/20) is the sim sweep's (benchmark/sim_bench.py).
+            obj = generate(seed, sizes=(4,))
             scenarios.append((parse_scenario(obj), obj))
 
     # The '{name}' template only prevents collisions between DISTINCT

@@ -267,28 +267,27 @@ async def _pipeline(
             await asyncio.sleep(0)  # one scheduling point per insert
 
     feed = loop.create_task(feeder())
-    # Quiesce detection is TICK-based, not wall-clock-based: a real-time
-    # poll (sleep(0.01)) would inject schedule noise mid-workload and
-    # break per-seed byte-reproducibility of the outcome — the repro
-    # contract a divergent seed is dumped under.  The run is done when
-    # the feeder finished, every queue drained, every background batch
-    # task died, and the commit count held still for 50 consecutive
-    # scheduling ticks.  The wall-clock guard is a last-resort deadlock
-    # bailout only (a schedule-induced hang IS a finding).
+    # Quiesce detection runs on VIRTUAL time (the loop is a
+    # VirtualClockLoop): each poll is a 1 ms simulated timer, which only
+    # fires when every workload task has quiesced — so the poll can
+    # never interleave into a busy schedule, and both the idle counting
+    # and the deadlock guard below are pure functions of the seed.  The
+    # run is done when the feeder finished, every queue drained, every
+    # background batch task died, and the commit count held still for 50
+    # consecutive quiesce polls.  The guard is a virtual deadline: a
+    # schedule-induced hang reaches it in microseconds of wall time and
+    # ALWAYS at the same virtual instant for a given seed — a
+    # deterministic finding, not a host-speed artifact.
     from narwhal_tpu.utils import tasks as task_util
 
-    guard = loop.time() + 45
+    guard = loop.time() + 45  # virtual seconds
     guard_tripped = False
     idle, prev = 0, None
     while idle < 50:
         if loop.time() >= guard:
-            # Wall-clock bailout: only a schedule-induced hang (or a
-            # pathologically slow host) reaches this.  Flagged in the
-            # report because a guard-truncated run is cut at a
-            # wall-clock-dependent point and is NOT byte-reproducible.
             guard_tripped = True
             break
-        await asyncio.sleep(0)
+        await asyncio.sleep(0.001)
         snapshot = (
             len(committed), feed.done(), rx.qsize(),
             tx_primary.qsize(), tx_output.qsize(),
@@ -327,7 +326,8 @@ def run_pipeline_seed(
     (committed, guard_tripped), stats = run_with_seed(
         lambda: _pipeline(cls, committee, stream, audit),
         seed,
-        timeout=90,
+        timeout=90,  # virtual seconds — deterministic per seed
+        virtual_time=True,
     )
     verdict = replay_segments(committee, GC_DEPTH, [audit])
     identical = committed == want

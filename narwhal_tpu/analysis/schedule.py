@@ -109,15 +109,25 @@ def run_with_seed(
     main: Callable[[], Coroutine],
     seed: int,
     timeout: Optional[float] = None,
+    virtual_time: bool = False,
 ) -> Any:
     """``asyncio.run`` under an :class:`ExploringEventLoop` seeded with
     ``seed``; returns ``(result, loop_stats)`` where ``loop_stats`` is a
     dict with the tick/permutation counts (the non-vacuity witness).
 
-    ``timeout`` (wall seconds, enforced via ``asyncio.wait_for``) turns
-    a schedule-induced deadlock into a failure with the seed attached
+    ``timeout`` (enforced via ``asyncio.wait_for``) turns a
+    schedule-induced deadlock into a failure with the seed attached
     instead of a hung harness.
-    """
+
+    ``virtual_time=True`` delegates to the simulation harness's
+    :func:`narwhal_tpu.sim.clock.run_virtual`: same exploring loop, but
+    ``loop.time()`` runs on simulated seconds that jump at quiesce —
+    ``timeout`` then bounds VIRTUAL seconds, so the guard is
+    deterministic per seed instead of host-speed-dependent."""
+    if virtual_time:
+        from ..sim.clock import run_virtual
+
+        return run_virtual(main, seed, max_virtual_s=timeout)
     loop = ExploringEventLoop(seed)
     try:
         asyncio.set_event_loop(loop)

@@ -28,11 +28,13 @@ frozen r06 oracle (``consensus/golden.py``):
   and no two distinct digests commit for one (round, origin) slot across
   the whole run (equivocation must never doubly commit);
 - **causal history** — every committed certificate's parents are genesis,
-  committed earlier, already below the origin's committed frontier when
-  the burst fired, or GC'd out of the window; parents that cannot be
-  resolved against the inserted-certificate index are *counted* as
-  unverifiable (a restored node legitimately commits above history it
-  never re-synced) rather than silently passed.
+  committed earlier, already below the origin's ROLLING committed
+  frontier at the moment the child commits (the walk's ≥-skip may be
+  triggered mid-burst by an earlier leader's flush), or GC'd out of the
+  window; parents that cannot be resolved against the
+  inserted-certificate index are *counted* as unverifiable (a restored
+  node legitimately commits above history it never re-synced) rather
+  than silently passed.
 
 :func:`cross_node_prefix` is the committee half of the verdict: every
 honest node's (re-delivery-deduplicated) commit sequence must be a byte
@@ -163,6 +165,15 @@ def replay_segments(
         golden_committed_set: set = set()
         recorded: List[bytes] = []
         seg_seen: set = set()
+        # Rolling committed frontier per origin, updated per EMITTED
+        # commit (not per burst): within one multi-leader burst an
+        # earlier leader's flush can advance an origin's frontier past a
+        # cert the walk then legitimately ≥-skips — a parent excused
+        # mid-burst.  A burst-entry snapshot misses that window and
+        # flagged byte-identical-to-oracle runs as causal violations
+        # (found by the sim sweep's deeper DAGs; the walk itself was
+        # correct).
+        frontier: Dict[bytes, Round] = dict(golden.state.last_committed)
         for tag, payload in records[1:]:
             if tag == TAG_RESTORE:
                 violations.append(
@@ -195,7 +206,6 @@ def replay_segments(
                 )
                 break
             inserts[bytes(cert.digest())] = cert
-            pre_frontier = dict(golden.state.last_committed)
             sequence = golden.process_certificate(cert)
             for x in sequence:
                 d = bytes(x.digest())
@@ -214,7 +224,7 @@ def replay_segments(
                         # commits above history it never re-synced.
                         unverifiable_parents += 1
                         continue
-                    if pre_frontier.get(pc.origin, 0) >= pc.round:
+                    if frontier.get(pc.origin, 0) >= pc.round:
                         continue  # excluded by the committed frontier
                     if (
                         pc.round + gc_depth
@@ -236,6 +246,9 @@ def replay_segments(
                     )
                 slots_committed[slot] = d
                 slot_by_digest[d] = slot
+                ob = bytes(x.origin)
+                if x.round > frontier.get(ob, 0):
+                    frontier[ob] = x.round
         golden_total += len(golden_commits)
         # Oracle equivalence: the node's recorded sequence must be a byte
         # prefix of the oracle's (a crash can lose a flushed burst's tail

@@ -42,11 +42,41 @@ except ImportError:  # minimal container: pure-Python fallback
         stacklevel=2,
     )
 
+import hashlib as _hashlib
 import time as _time
 
 from .. import metrics as _metrics
 from . import _ed25519_py
 from .digest import Digest
+
+# -- simulation MAC mode ------------------------------------------------------
+#
+# The deterministic simulation harness (narwhal_tpu/sim) replaces ed25519
+# sign/verify with a keyed hash: sig = SHA-512(public_key ‖ message)[:64].
+# Protocol-visible semantics are preserved — a signature only verifies
+# against the key it was minted under, so the wrong_key Byzantine
+# behavior still reads as invalid, twins stay validly signed, and every
+# frame keeps its real wire size — but one op costs ~2 µs instead of the
+# ~1-4 ms of the pure-Python fallback, which is what lets an N=20/50
+# committee execute 60 virtual seconds in single-digit wall seconds.
+# NOT a signature scheme (anyone holding the public key can forge);
+# never enabled outside the sim harness, which brackets every run with
+# set_sim_mac(True/False).
+
+_SIM_MAC = False
+
+
+def set_sim_mac(enabled: bool) -> None:
+    global _SIM_MAC
+    _SIM_MAC = bool(enabled)
+
+
+def sim_mac_enabled() -> bool:
+    return _SIM_MAC
+
+
+def _sim_mac(public: bytes, message: bytes) -> bytes:
+    return _hashlib.sha512(bytes(public) + bytes(message)).digest()[:64]
 
 # Crypto-cost ledger, signing side: op counts and wall time per call
 # site ("header" / "vote" via SignatureService, "other" for direct
@@ -169,6 +199,8 @@ class KeyPair:
         ops, secs = _sign_instruments(site)
         t0 = _time.perf_counter()
         try:
+            if _SIM_MAC:
+                return Signature(_sim_mac(self.name, bytes(digest)))
             if self._sk is not None:
                 return Signature(self._sk.sign(bytes(digest)))
             a, prefix, pub = self._py_expanded
@@ -195,6 +227,8 @@ class KeyPair:
 def cpu_verify(message: bytes, key: PublicKey, signature: Signature) -> bool:
     """Single strict-ish verification via OpenSSL (pure-Python RFC 8032
     fallback when the `cryptography` package is absent)."""
+    if _SIM_MAC:
+        return _sim_mac(key, message) == bytes(signature)
     if not _HAVE_OPENSSL:
         return _ed25519_py.verify(bytes(key), bytes(message), bytes(signature))
     try:

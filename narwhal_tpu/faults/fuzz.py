@@ -30,7 +30,13 @@ The generator never touches the process RNG: everything flows from one
 from __future__ import annotations
 
 import random
-from typing import Dict, List, Tuple
+from typing import Dict, List, Sequence, Tuple
+
+# Committee sizes a draw may pick.  4/7/10 are what the socketed
+# one-host runner can carry (it passes a pruned pool); 20 is the
+# committee-at-scale point the deterministic simulation harness
+# (narwhal_tpu/sim, benchmark/sim_bench.py) exists to explore.
+SIZES: Tuple[int, ...] = (4, 7, 10, 20)
 
 # (behavior, expected rules, env knobs, parameter overrides) — the
 # detection contract of each plane, mirrored from the hand-written
@@ -68,12 +74,18 @@ _WORKER_POOL: List[Tuple[str, List[str], Dict[str, str], Dict[str, int]]] = [
 ]
 
 
-def generate(seed: int) -> dict:
+def generate(seed: int, sizes: Sequence[int] = SIZES) -> dict:
     """One seeded scenario-spec dict (see module docstring).  Pass the
     result to ``narwhal_tpu.faults.spec.parse_scenario`` (fault_bench
-    does) — the generator stays within the schema's bounds, and parsing
-    re-validates every invariant regardless."""
+    and sim_bench do) — the generator stays within the schema's bounds,
+    and parsing re-validates every invariant regardless.
+
+    ``sizes`` is the committee-size pool the draw picks from (the
+    socketed runner prunes it to what one host can carry; the sim
+    harness uses the full pool).  All faults still land on ONE node, so
+    the faulted-node union is 1 ≤ f at every size in the pool."""
     rng = random.Random(seed)
+    nodes = rng.choice(list(sizes))
 
     env: Dict[str, str] = {}
     parameters: Dict[str, int] = {}
@@ -94,9 +106,24 @@ def generate(seed: int) -> dict:
         rules.update(expect)
         env.update(env_knobs)
         parameters.update(param_knobs)
+    # Behavior masking: wrong_key makes every header of the adversary
+    # invalid, so honest peers never accept the headers that would
+    # reference its batches — and without accepted references nobody
+    # requests the bytes, which is the ONLY evidence path the
+    # batch-availability rules observe.  The worker behavior still runs
+    # (stress), but its rule leaves the detection contract: expecting it
+    # would make the verdict fail for a reason that is protocol
+    # semantics, not a detection gap (found by the sim sweep at N=10).
+    if "wrong_key" in behaviors:
+        rules.discard("batch_withholding")
+        rules.discard("garbage_batches")
 
-    duration = 35 if "replay_stale" in behaviors else 30
-    byz_node = rng.randrange(4)
+    # Duration draw: scenario length varies per seed; replay_stale needs
+    # the extra tail for the GC horizon to pass the replayed rounds.
+    duration = rng.choice([25, 30, 35])
+    if "replay_stale" in behaviors:
+        duration = max(duration, 35)
+    byz_node = rng.randrange(nodes)
     byz_entry: dict = {"node": byz_node, "behaviors": behaviors}
     if "replay_stale" in behaviors:
         byz_entry["replay_interval_ms"] = 100
@@ -105,7 +132,7 @@ def generate(seed: int) -> dict:
 
     obj: dict = {
         "name": f"fuzz_{seed}",
-        "nodes": 4,
+        "nodes": nodes,
         "workers": 1,
         "rate": rng.choice([1_500, 2_000, 2_500]),
         "tx_size": 512,

@@ -253,6 +253,75 @@ class _ShapedWriter:
         return self._w.transport
 
 
+# -- scenario wan-plane resolution --------------------------------------------
+
+
+def resolve_wan_plane(scenario, committee, names) -> Dict[str, dict]:
+    """Resolve a scenario's ``wan`` plane (committee-wide defaults,
+    per-directed-pair overrides, partition windows — see
+    ``faults/spec.py::WanSpec``) into per-node-label shaping config:
+    ``{label: {"rules": [{dst, latency_ms, jitter_ms, loss}],
+    "partitions": [{"peers": [...], "from_s", "until_s"}]}}`` with
+    destination ADDRESSES.  Intra-authority LAN traffic stays unshaped.
+    The ONE compilation both fault harnesses consume:
+    ``benchmark/fault_bench.py`` wraps it into the per-process config
+    file this module loads, and ``narwhal_tpu/sim/transport.py`` feeds
+    it to the in-memory transport — so the socketed and simulated WAN
+    semantics can never drift apart."""
+    wan = scenario.wan
+    if wan is None:
+        return {}
+    nodes: Dict[str, dict] = {}
+
+    def entry(label: str) -> dict:
+        return nodes.setdefault(label, {"rules": [], "partitions": []})
+
+    def wan_addresses(j: int) -> List[str]:
+        auth = committee.authorities[names[j]]
+        return [auth.primary.primary_to_primary] + [
+            w.worker_to_worker for w in auth.workers.values()
+        ]
+
+    pair_shapes = {(p.src, p.dst): p for p in wan.pairs}
+    for i in range(scenario.nodes):
+        labels = [f"primary-{i}"] + [
+            f"worker-{i}-{wid}" for wid in range(scenario.workers)
+        ]
+        for j in range(scenario.nodes):
+            if j == i:
+                continue  # intra-authority traffic stays LAN-fast
+            p = pair_shapes.get((i, j))
+            shape = {
+                "latency_ms": p.latency_ms if p else wan.latency_ms,
+                "jitter_ms": p.jitter_ms if p else wan.jitter_ms,
+                "loss": p.loss if p else wan.loss,
+            }
+            if not any(shape.values()):
+                continue
+            for dst in wan_addresses(j):
+                for label in labels:
+                    entry(label)["rules"].append(dict(shape, dst=dst))
+        for part in wan.partitions:
+            group = set(part.group)
+            cut = (
+                [j for j in range(scenario.nodes) if j not in group]
+                if i in group
+                else list(group)
+            )
+            peers = [a for j in cut for a in wan_addresses(j)]
+            if not peers:
+                continue
+            for label in labels:
+                entry(label)["partitions"].append(
+                    {
+                        "peers": peers,
+                        "from_s": part.from_s,
+                        "until_s": part.until_s,
+                    }
+                )
+    return nodes
+
+
 # -- process-wide accessor -----------------------------------------------------
 
 _EMULATOR: Optional[NetEmulator] = None

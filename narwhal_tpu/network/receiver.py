@@ -12,6 +12,7 @@ from typing import Protocol
 from .. import metrics
 from ..utils.env import env_flag
 from ..utils.tasks import spawn
+from . import transport as _transport
 from .framing import (
     STREAM_LIMIT,
     FrameError,
@@ -69,6 +70,13 @@ class Receiver:
     async def spawn(
         cls, address: str, handler: MessageHandler, classify=None
     ) -> "Receiver":
+        # Transport seam: an installed in-memory transport (deterministic
+        # simulation) owns every listener in the process — same handler
+        # contract, frames arrive from seeded in-process queues instead
+        # of sockets.
+        sim = _transport.active()
+        if sim is not None:
+            return sim.spawn_receiver(address, handler, classify)
         self = cls(address, handler, classify)
         host, port = parse_address(address)
         # NARWHAL_BIND_ANY=1: listen on 0.0.0.0 with the committee port

@@ -23,6 +23,7 @@ from .. import metrics
 from ..faults import netem as _netem
 from ..utils.env import env_raw
 from ..utils.tasks import spawn
+from . import transport as _transport
 from .framing import (
     MAX_FRAME,
     STREAM_LIMIT,
@@ -349,6 +350,16 @@ class _Connection:
 
 
 class ReliableSender:
+    def __new__(cls):
+        # Transport seam: see SimpleSender.__new__ — an installed
+        # in-memory transport provides the drop-in counterpart (same
+        # future-per-send delivery contract, resolved with the peer's
+        # ACK) so every call site keeps writing `ReliableSender()`.
+        sim = _transport.active()
+        if sim is not None and cls is ReliableSender:
+            return sim.reliable_sender()
+        return super().__new__(cls)
+
     def __init__(self) -> None:
         self._connections: Dict[str, _Connection] = {}
         _SENDERS.add(self)

@@ -14,6 +14,7 @@ from typing import Dict, Sequence
 from .. import metrics
 from ..faults import netem as _netem
 from ..utils.tasks import spawn
+from . import transport as _transport
 from .framing import (
     STREAM_LIMIT,
     parse_address,
@@ -88,6 +89,17 @@ class _Peer:
 
 
 class SimpleSender:
+    def __new__(cls):
+        # Transport seam: under an installed in-memory transport
+        # (deterministic simulation) construction yields the sim
+        # counterpart — call sites keep writing `SimpleSender()` and the
+        # swap happens here, exactly like Receiver.spawn.  Subclasses
+        # (none today) would build the TCP sender as written.
+        sim = _transport.active()
+        if sim is not None and cls is SimpleSender:
+            return sim.simple_sender()
+        return super().__new__(cls)
+
     def __init__(self) -> None:
         self._peers: Dict[str, _Peer] = {}
 

@@ -51,6 +51,10 @@ class PrimaryNode:
         self.primary: Optional[Primary] = None
         self.tasks: List[asyncio.Task] = []
         self.store: Optional[Store] = None
+        # The Consensus instance, retained so in-process harnesses (the
+        # simulation committee) can flush/close its audit segment at
+        # quiesce — a subprocess node does this on SIGTERM instead.
+        self.consensus = None
 
     async def shutdown(self) -> None:
         for task in self.tasks:
@@ -72,6 +76,10 @@ async def spawn_primary_node(
     use_kernel: bool = False,
     fault_plan=None,
     audit_path: Optional[str] = None,
+    store: Optional[Store] = None,
+    consensus_cls=None,
+    replay_persisted: bool = False,
+    channel_capacity: Optional[int] = None,
 ) -> PrimaryNode:
     """Primary + Consensus pair with the GC feedback loop.  `on_commit`
     (sync callable) is the application layer — the reference's `analyze()`
@@ -80,12 +88,20 @@ async def spawn_primary_node(
     ``fault_plan`` wires the Byzantine Proposer/Core wrappers (fault
     suite); ``audit_path`` (default: the ``NARWHAL_CONSENSUS_AUDIT`` env
     var) makes Consensus append its insert/commit audit segment for the
-    golden-oracle safety replay."""
+    golden-oracle safety replay.
+
+    Injectable wiring for in-process harnesses (the simulation committee
+    boots dozens of these on one loop): ``store`` hands the node an
+    existing Store object (a sim crash/restart preserves the in-memory
+    store across incarnations the way a SIGKILL preserves the on-disk
+    one); ``consensus_cls`` swaps the Consensus runner (planted-mutation
+    arms); ``replay_persisted`` forces the boot-time certificate replay
+    even without a ``store_path`` (the retained-store restart needs it)."""
     node = PrimaryNode()
     if audit_path is None:
         audit_path = env_str("NARWHAL_CONSENSUS_AUDIT") or None
     loop = asyncio.get_running_loop()
-    node.store = Store(store_path)
+    node.store = Store(store_path) if store is None else store
 
     # If the TPU verify backend is selected, compile/cache-load the kernel
     # for the live burst shapes BEFORE joining the committee: the first
@@ -101,15 +117,16 @@ async def spawn_primary_node(
         backend.warmup(max_claims=derive_max_claims(committee))
         log.info("Verify backend %s ready", backend.name)
 
+    cap = CHANNEL_CAPACITY if channel_capacity is None else channel_capacity
     tx_new_certificates = asyncio.Queue(maxsize=CHANNEL_CAPACITY)
-    tx_feedback = asyncio.Queue(maxsize=CHANNEL_CAPACITY)
-    tx_output = asyncio.Queue(maxsize=CHANNEL_CAPACITY)
+    tx_feedback = asyncio.Queue(maxsize=cap)
+    tx_output = asyncio.Queue(maxsize=cap)
 
     # Same for the consensus kernel: compile its one static window shape
     # before the primary joins the committee (KernelTusk.prewarm docstring),
     # which is why the Consensus is built before Primary.spawn logs the
     # boot banner the harness waits on.
-    consensus = Consensus(
+    consensus = (consensus_cls or Consensus)(
         committee,
         parameters.gc_depth,
         rx_primary=tx_new_certificates,
@@ -132,6 +149,7 @@ async def spawn_primary_node(
         log.info("Warming up consensus kernel...")
         consensus.tusk.prewarm()
         log.info("Consensus kernel ready")
+    node.consensus = consensus
 
     node.primary = await Primary.spawn(
         keypair,
@@ -164,7 +182,7 @@ async def spawn_primary_node(
     # store: every parseable certificate above the restored per-author
     # frontier, oldest round first.  Runs as a task after the Primary is
     # up so the consensus GC feedback loop is already draining.
-    if store_path is not None:
+    if store_path is not None or replay_persisted:
         node.tasks.append(
             spawn(
                 _replay_persisted_certificates(
@@ -235,11 +253,14 @@ async def spawn_worker_node(
     store_path: Optional[str] = None,
     benchmark: bool = False,
     fault_plan=None,
+    store: Optional[Store] = None,
 ) -> WorkerNode:
     """``fault_plan`` wires the Byzantine worker wrappers (batch
     withholding / garbage serving / sync flooding — the fault suite's
-    worker-plane adversary); None is the honest worker."""
-    store = Store(store_path)
+    worker-plane adversary); None is the honest worker.  ``store`` hands
+    the worker an existing Store object (sim crash/restart; see
+    spawn_primary_node)."""
+    store = Store(store_path) if store is None else store
     worker = await Worker.spawn(
         keypair.name,
         worker_id,

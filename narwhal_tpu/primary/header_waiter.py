@@ -13,7 +13,6 @@ from __future__ import annotations
 
 import asyncio
 import logging
-import time
 from typing import Dict, List, Tuple
 
 from ..config import Committee
@@ -24,6 +23,7 @@ from ..store import Store
 from .core import AtomicRound
 from .messages import Header, encode_certificates_request
 from .synchronizer import payload_key
+from ..utils.clock import loop_now
 from ..utils.tasks import spawn
 
 log = logging.getLogger("narwhal.primary")
@@ -107,7 +107,7 @@ class HeaderWaiter:
         if header.id in self.pending:
             return
         # Optimistically ask the header author; the timer escalates later.
-        now = time.monotonic()
+        now = loop_now()
         to_request = []
         for digest in missing:
             if digest not in self.parent_requests:
@@ -138,7 +138,7 @@ class HeaderWaiter:
     async def _timer(self) -> None:
         while True:
             await asyncio.sleep(TIMER_RESOLUTION)
-            now = time.monotonic()
+            now = loop_now()
             overdue = []
             for d, (_, t) in list(self.parent_requests.items()):
                 if now - t < self.sync_retry_delay:

@@ -198,11 +198,18 @@ class Certificate:
         return self.header.author
 
     def digest(self) -> Digest:
-        w = Writer()
-        w.raw(self.header.id)
-        w.u64(self.round)
-        w.raw(self.origin)
-        return digest32(w.finish())
+        # Memoized: H(header_id ‖ round ‖ origin) never changes after
+        # construction (votes do not participate), and the commit path
+        # asks for it ~10× per certificate per node — at committee scale
+        # the recomputation was a measured top-10 cost.
+        d = getattr(self, "_digest", None)
+        if d is None:
+            w = Writer()
+            w.raw(self.header.id)
+            w.u64(self.round)
+            w.raw(self.origin)
+            d = self._digest = digest32(w.finish())
+        return d
 
     def verify_structure(self, committee: Committee) -> None:
         """Quorum + reuse + authority checks (reference messages.rs:189-213,
@@ -265,12 +272,37 @@ class Certificate:
         return cls(header, votes)
 
     def serialize(self) -> bytes:
-        w = Writer()
-        self.encode(w)
-        return w.finish()
+        # Memoized like digest(): the same certificate is re-serialized
+        # for the store write, the audit insert, and helper re-serves.
+        # Votes are final by the time anything serializes a certificate
+        # (the aggregator builds the object once, complete).
+        wire = getattr(self, "_wire", None)
+        if wire is None:
+            w = Writer()
+            self.encode(w)
+            wire = self._wire = w.finish()
+        return wire
 
     @classmethod
     def deserialize(cls, data: bytes) -> "Certificate":
+        # Same single-process memo as decode_primary_message: the
+        # dependency checks deserialize a header's ~N stored parents on
+        # every process_header, and in a simulated committee the same
+        # stored bytes recur across all N nodes.
+        if _DECODE_CACHE_ON:
+            key = (b"C", data)
+            cert = _DECODE_CACHE.get(key)
+            if cert is not None:
+                return cert
+            cert = cls._deserialize(data)
+            if len(_DECODE_CACHE) >= _DECODE_CACHE_CAP:
+                _DECODE_CACHE.clear()
+            _DECODE_CACHE[key] = cert
+            return cert
+        return cls._deserialize(data)
+
+    @classmethod
+    def _deserialize(cls, data: bytes) -> "Certificate":
         r = Reader(data)
         cert = cls.decode(r)
         r.expect_done()
@@ -294,13 +326,32 @@ class Certificate:
         )
 
 
+_GENESIS_CACHE: "weakref.WeakKeyDictionary[Committee, List[Certificate]]" = None  # type: ignore
+
+
 def genesis(committee: Committee) -> List[Certificate]:
     """One unsigned certificate per authority at round 0
-    (reference messages.rs:175-187)."""
-    return [
-        Certificate(header=Header(author=name, round=0, payload={}, parents=set()))
-        for name in committee.authorities
-    ]
+    (reference messages.rs:175-187).  Memoized per committee object:
+    ``Certificate.verify_structure`` consults this list for EVERY
+    certificate sanitized, and rebuilding N certificates (each hashing
+    its header) per call was a measured top-5 cost of a simulated N=20
+    committee.  Callers treat the result as immutable."""
+    global _GENESIS_CACHE
+    if _GENESIS_CACHE is None:
+        import weakref
+
+        _GENESIS_CACHE = weakref.WeakKeyDictionary()
+    cached = _GENESIS_CACHE.get(committee)
+    if cached is None:
+        cached = _GENESIS_CACHE[committee] = [
+            Certificate(
+                header=Header(
+                    author=name, round=0, payload={}, parents=set()
+                )
+            )
+            for name in committee.authorities
+        ]
+    return cached
 
 
 # --- primary ↔ primary wire frames ------------------------------------------
@@ -346,9 +397,44 @@ def encode_certificates_request(digests: List[Digest], requestor: PublicKey) -> 
     return w.finish()
 
 
+# Frame-decode memo for single-process committees (the simulation
+# harness): a broadcast header/certificate frame arrives at N-1 in-process
+# receivers (and again via helper re-serves), and each arrival would
+# repeat the full field-by-field parse — the measured #1 wall cost of an
+# N=20 sim round.  Decoded messages are treated immutably everywhere
+# (receivers never write into a decoded Header/Certificate; aggregators
+# build their own state), so sharing one decoded object per distinct
+# frame is safe.  OFF by default: a multi-process node sees each frame
+# once and the memo would only hold dead objects.
+_DECODE_CACHE: dict = {}  # bytes frame → decoded tuple; (b"C", bytes) → Certificate
+_DECODE_CACHE_CAP = 16_384
+_DECODE_CACHE_ON = False
+
+
+def set_decode_cache(enabled: bool) -> None:
+    """Enable/disable the frame-decode memo (simulation harness only);
+    disabling also drops the cached objects."""
+    global _DECODE_CACHE_ON
+    _DECODE_CACHE_ON = bool(enabled)
+    _DECODE_CACHE.clear()
+
+
 def decode_primary_message(data: bytes):
     """Returns ("header", Header) | ("vote", Vote) | ("certificate", Certificate)
     | ("certificates_request", digests, requestor)."""
+    if _DECODE_CACHE_ON:
+        out = _DECODE_CACHE.get(data)
+        if out is not None:
+            return out
+        out = _decode_primary_message(data)
+        if len(_DECODE_CACHE) >= _DECODE_CACHE_CAP:
+            _DECODE_CACHE.clear()  # wholesale: entries age together
+        _DECODE_CACHE[data] = out
+        return out
+    return _decode_primary_message(data)
+
+
+def _decode_primary_message(data: bytes):
     r = Reader(data)
     tag = r.u8()
     if tag == PM_HEADER:

@@ -1,0 +1,31 @@
+"""``loop_now()`` — the protocol plane's one clock.
+
+Every age/retry/deadline computation that lives ON the event loop reads
+this instead of ``time.monotonic()``.  In production the two are the
+same clock (asyncio's default ``loop.time()`` IS ``time.monotonic()``),
+so this is a pure refactor there — but under the deterministic
+simulation harness (``narwhal_tpu/sim``) the running loop is a
+:class:`~narwhal_tpu.sim.clock.VirtualClockLoop` whose ``time()``
+advances only at quiesce, and every retry window, sync age and wedge
+timer rides the simulated clock with it.  A wall-clock read left behind
+in a retry path would measure ~zero elapsed time across a 60-virtual-
+second scenario and silently disable that path in simulation.
+
+Callers off the loop (metrics snapshot threads) fall back to
+``time.monotonic()`` — consistent in production, and simulation runs
+everything on the one loop so the fallback never fires there.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+
+def loop_now() -> float:
+    """The running event loop's time, or ``time.monotonic()`` when called
+    outside any loop (snapshot/scrape threads)."""
+    try:
+        return asyncio.get_running_loop().time()
+    except RuntimeError:
+        return time.monotonic()

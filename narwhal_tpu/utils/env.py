@@ -259,6 +259,25 @@ _VARS = [
         "processes.",
         shown_default="~/.cache/narwhal_tpu_jax",
     ),
+    # -- deterministic simulation (narwhal_tpu/sim) ---------------------------
+    EnvVar(
+        "NARWHAL_SIM_SEED", "int", None,
+        "Overrides the base seed of `benchmark/sim_bench.py` sweeps "
+        "(each point derives its run seed from this + its index); unset "
+        "= the CLI's --seed-base.",
+    ),
+    EnvVar(
+        "NARWHAL_SIM_COMPRESSION_CAP", "float", 60.0,
+        "Ceiling on a single virtual-clock quiesce jump in simulated "
+        "seconds; a forgotten far-future timer advances the clock in "
+        "bounded non-blocking steps instead of one leap. 0 = uncapped.",
+    ),
+    EnvVar(
+        "NARWHAL_SIM_MAX_VIRTUAL_S", "float", 600.0,
+        "Ceiling on one sim run's total virtual duration, enforced as a "
+        "virtual-time wait_for: a livelocked scenario terminates with a "
+        "deterministic timeout instead of spinning forever.",
+    ),
     # -- fault injection ------------------------------------------------------
     EnvVar(
         "NARWHAL_FAULT_PLAN", "str", None,

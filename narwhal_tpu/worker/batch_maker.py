@@ -31,6 +31,7 @@ from .. import metrics, native
 from ..config import Committee, WorkerId
 from ..crypto import PublicKey, digest32
 from ..network import ReliableSender
+from ..network import transport as _transport
 from ..network.framing import parse_address
 from ..utils.tasks import spawn
 
@@ -130,9 +131,19 @@ class BatchMaker:
         self._loop = asyncio.get_running_loop()
         host, port = parse_address(self.address)
         try:
-            self._server = await self._loop.create_server(
-                lambda: _TxProtocol(self), host, port
-            )
+            # Transport seam (see network/transport.py): an installed
+            # in-memory transport owns the client-transaction ingress
+            # too — the simulation harness's clients feed _TxProtocol
+            # through seeded in-process connections, no kernel socket.
+            sim = _transport.active()
+            if sim is not None:
+                self._server = sim.create_tx_server(
+                    self.address, lambda: _TxProtocol(self)
+                )
+            else:
+                self._server = await self._loop.create_server(
+                    lambda: _TxProtocol(self), host, port
+                )
         except BaseException as e:
             # Surface bind failures to Worker.spawn (which waits on
             # `started`) instead of dying silently in this task.

@@ -10,12 +10,12 @@ from __future__ import annotations
 
 import asyncio
 import logging
-import time
 from typing import Optional
 
 from .. import metrics
 from ..config import Committee
 from ..crypto import PublicKey
+from ..utils.clock import loop_now
 from ..utils.env import env_flag
 
 log = logging.getLogger("narwhal.worker")
@@ -51,7 +51,7 @@ class QuorumWaiter:
             lambda: (
                 0.0
                 if self._wait_started is None
-                else max(0.0, time.time() - self._wait_started)
+                else max(0.0, loop_now() - self._wait_started)
             ),
         )
 
@@ -66,7 +66,7 @@ class QuorumWaiter:
             # wire + peer validation + ACK return (minus queue time in
             # to_quorum, which the queue-depth gauge exposes separately).
             t0 = loop.time()
-            self._wait_started = time.time()
+            self._wait_started = loop_now()
             total = self.committee.stake(self.name)  # our own stake counts
             self._m_acked_stake.set(total)
             pending = {fut: stake for stake, fut in handlers}

@@ -29,7 +29,6 @@ from __future__ import annotations
 import asyncio
 import logging
 import random
-import time
 import weakref
 from typing import Dict
 
@@ -40,6 +39,7 @@ from ..messages import Round, encode_batch_request
 from ..network import SimpleSender
 from ..network.reliable_sender import next_backoff
 from .helper import max_request_digests
+from ..utils.clock import loop_now
 from ..utils.tasks import spawn
 
 log = logging.getLogger("narwhal.worker")
@@ -59,7 +59,7 @@ def _oldest_unserved_age() -> float:
                 oldest = p.first_ts
     if oldest is None:
         return 0.0
-    return max(0.0, time.monotonic() - oldest)
+    return max(0.0, loop_now() - oldest)
 
 
 metrics.gauge_fn("worker.unserved_sync_age_seconds", _oldest_unserved_age)
@@ -144,7 +144,7 @@ class Synchronizer:
 
     async def _synchronize(self, digests, target: PublicKey) -> None:
         missing = []
-        now = time.monotonic()
+        now = loop_now()
         for digest in digests:
             if digest in self.pending:
                 continue
@@ -196,7 +196,7 @@ class Synchronizer:
         (reference synchronizer.rs:191-222), one jittered backoff window
         per digest; returns how many digests were re-requested (``now``
         is injectable so tests drive the windows deterministically)."""
-        now = time.monotonic() if now is None else now
+        now = loop_now() if now is None else now
         overdue = []
         for digest, p in self.pending.items():
             if now < p.due:

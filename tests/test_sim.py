@@ -1,0 +1,243 @@
+"""narwhal-sim acceptance suite (ISSUE 12).
+
+- the virtual clock jumps at quiesce (compression), caps single jumps,
+  and bounds deadlocked runs deterministically;
+- a clean simulated committee passes all three verdicts, and the same
+  (seed, spec) twice produces a BYTE-IDENTICAL deterministic artifact
+  (commit sequences + verdicts + events + schedule);
+- mutation arms (the PR 8/10 honesty pattern): a planted Byzantine
+  behavior is caught by the detection verdict, and the planted
+  RacyConsensus shape is caught by a safety verdict under a pinned
+  schedule seed — the harness detects what it claims to detect;
+- fuzz grows committee-size and duration draws while every draw stays
+  schema-valid under the BFT union bound.
+"""
+
+import asyncio
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from narwhal_tpu.faults.fuzz import SIZES, generate  # noqa: E402
+from narwhal_tpu.faults.spec import parse_scenario  # noqa: E402
+from narwhal_tpu.sim import run_sim_scenario, run_virtual  # noqa: E402
+from narwhal_tpu.sim.committee import deterministic_blob  # noqa: E402
+
+logging.disable(logging.WARNING)
+
+# Schedule seed under which the RacyConsensus mutation arm is known to
+# diverge for _RACY_SPEC below (sim_bench's mutation arm scans seeds;
+# the tier-1 test pins one so it costs a single run).
+RACY_PINNED_SEED = 30_000
+
+
+def _clean_spec(name="sim_t_clean", nodes=4, duration=15, seed=5):
+    return parse_scenario({
+        "name": name, "nodes": nodes, "workers": 1, "rate": 400,
+        "tx_size": 256, "duration": duration, "seed": seed,
+    })
+
+
+# -- virtual clock ------------------------------------------------------------
+
+
+def test_virtual_clock_compresses_idle_time():
+    async def main():
+        loop = asyncio.get_running_loop()
+        t0 = loop.time()
+        await asyncio.sleep(120)
+        return loop.time() - t0
+
+    elapsed, stats = run_virtual(main, seed=3)
+    assert elapsed == 120
+    assert stats["virtual_s"] >= 120
+    # 120 idle virtual seconds must cost (far) under a wall second.
+    assert stats["wall_s"] < 1.0
+    assert stats["jumps"] >= 1
+
+
+def test_virtual_clock_caps_single_jumps():
+    async def main():
+        await asyncio.sleep(500)
+
+    _, stats = run_virtual(main, seed=1, max_virtual_s=1_000)
+    # Default cap is 60 s/jump: a 500 s gap takes several capped steps.
+    assert stats["capped_jumps"] >= 8
+
+
+def test_virtual_deadlock_guard_is_deterministic():
+    async def dead():
+        await asyncio.Event().wait()
+
+    import pytest
+
+    for _ in range(2):
+        with pytest.raises(asyncio.TimeoutError):
+            run_virtual(dead, seed=2, max_virtual_s=5)
+
+
+def test_virtual_loop_keeps_schedule_exploration():
+    async def probe():
+        out = []
+        gate = asyncio.Event()
+
+        async def worker(i):
+            await gate.wait()
+            for _ in range(4):
+                out.append(i)
+                await asyncio.sleep(0)
+
+        tasks = [
+            asyncio.get_running_loop().create_task(worker(i))
+            for i in range(5)
+        ]
+        gate.set()
+        await asyncio.gather(*tasks)
+        return tuple(out)
+
+    orders = {run_virtual(probe, seed=s)[0] for s in range(6)}
+    assert len(orders) > 1, "virtual loop lost the exploration axis"
+    a = run_virtual(probe, seed=4)[0]
+    b = run_virtual(probe, seed=4)[0]
+    assert a == b
+
+
+# -- committee simulation -----------------------------------------------------
+
+
+def test_clean_committee_passes_all_three_verdicts(tmp_path):
+    art = run_sim_scenario(_clean_spec(), 21, str(tmp_path / "clean"))
+    v = art["verdicts"]
+    assert v["safety"]["ok"], v["safety"]
+    assert v["liveness"]["ok"], v["liveness"]
+    assert v["detection"]["ok"], v["detection"]
+    assert art["ok"]
+    # Non-vacuity: the run committed real payload and explored schedules.
+    assert all(
+        n["payload_commits_post_settle"] > 0
+        for n in v["liveness"]["nodes"].values()
+    )
+    assert art["schedule"]["permutations"] > 100
+    assert art["schedule"]["virtual_s"] >= 15
+
+
+def test_same_seed_spec_is_bit_reproducible(tmp_path):
+    """The repro contract: same (seed, spec) → byte-identical commit
+    sequences AND verdict artifacts across two runs."""
+    a = run_sim_scenario(_clean_spec(), 22, str(tmp_path / "a"))
+    b = run_sim_scenario(_clean_spec(), 22, str(tmp_path / "b"))
+    assert deterministic_blob(a) == deterministic_blob(b)
+    assert a["commit_sequences"] == b["commit_sequences"]
+
+
+def test_planted_byzantine_is_detected_without_being_expected(tmp_path):
+    """Honesty arm: an equivocating primary with NO expect.rules still
+    lights up the equivocation rule — detection is measurement, not
+    self-fulfilling configuration."""
+    spec = parse_scenario({
+        "name": "sim_t_eq", "nodes": 4, "workers": 1, "rate": 400,
+        "tx_size": 256, "duration": 20, "seed": 3,
+        "byzantine": [{"node": 1, "behaviors": ["equivocate"]}],
+    })
+    art = run_sim_scenario(spec, 23, str(tmp_path / "eq"))
+    assert "equivocation" in art["verdicts"]["detection"]["fired"]
+    # And safety holds: equivocation must never doubly commit.
+    assert art["verdicts"]["safety"]["ok"], art["verdicts"]["safety"]
+
+
+_RACY_SPEC = {
+    "name": "sim_mut_racy", "nodes": 4, "workers": 1, "rate": 600,
+    "tx_size": 256, "duration": 15, "seed": 7_000 ^ 0xACE,
+}
+
+
+def test_planted_racy_consensus_fails_a_safety_verdict(tmp_path):
+    """The other honesty arm: node 0 running the PR 10 found-race shape
+    must produce a golden-replay/prefix violation under the pinned
+    schedule seed — a sim harness that cannot catch a planted race is
+    dead weight."""
+    from benchmark.race_explore import RacyConsensus
+
+    art = run_sim_scenario(
+        parse_scenario(_RACY_SPEC, env={}), RACY_PINNED_SEED,
+        str(tmp_path / "racy"),
+        consensus_cls_by_node={0: RacyConsensus},
+    )
+    assert not art["verdicts"]["safety"]["ok"], (
+        "planted RacyConsensus was not caught at the pinned seed — "
+        "the sim harness's safety verdict went blind"
+    )
+
+
+def test_crash_restart_authority_recovers(tmp_path):
+    """Crash/restart plane: the restarted authority (retained in-memory
+    store, fresh audit segment) rejoins and keeps committing; the
+    peer_unreachable rule names the outage."""
+    spec = parse_scenario({
+        "name": "sim_t_crash", "nodes": 4, "workers": 1, "rate": 400,
+        "tx_size": 256, "duration": 30, "seed": 9,
+        "crash": [{"node": 2, "at_s": 8, "restart_at_s": 14}],
+        "env": {"NARWHAL_NET_BACKOFF_MAX_S": "2"},
+        "expect": {"rules": ["peer_unreachable"]},
+    })
+    art = run_sim_scenario(spec, 25, str(tmp_path / "crash"))
+    v = art["verdicts"]
+    assert v["safety"]["ok"], v["safety"]
+    assert v["liveness"]["ok"], v["liveness"]
+    assert "peer_unreachable" in v["detection"]["fired"]
+    # Two audit segments for the crashed node: one per incarnation.
+    assert v["safety"]["nodes"]["primary-2"]["segments"] == 2
+
+
+def test_committee_at_scale_compresses(tmp_path):
+    """An N=10 committee's 20 virtual seconds execute well under wall
+    real time — the committee-at-scale axis the socketed harness cannot
+    reach.  The bound is loose (shared CI cores); the real compression
+    gate lives in sim_bench's acceptance arm."""
+    spec = _clean_spec(name="sim_t_n10", nodes=10, duration=20, seed=4)
+    art = run_sim_scenario(spec, 26, str(tmp_path / "n10"))
+    assert art["ok"], art["verdicts"]
+    assert art["schedule"]["virtual_s"] >= 20
+    assert art["wall"]["compression"] and art["wall"]["compression"] > 1.0
+
+
+# -- fuzz growth --------------------------------------------------------------
+
+
+def test_fuzz_draws_cover_sizes_and_durations():
+    sizes = set()
+    durations = set()
+    for seed in range(120):
+        obj = generate(seed)
+        sizes.add(obj["nodes"])
+        durations.add(obj["duration"])
+        s = parse_scenario(obj, env={})  # schema + BFT bound revalidate
+        f_tol = (s.nodes - 1) // 3
+        faulted = set(s.byzantine_nodes()) | {c.node for c in s.crash}
+        assert len(faulted) <= f_tol
+    assert sizes == set(SIZES), f"size pool not covered: {sizes}"
+    assert len(durations) > 2, "duration draw is constant"
+
+
+def test_fuzz_size_pool_is_prunable():
+    for seed in (0, 1, 2):
+        obj = generate(seed, sizes=(4,))
+        assert obj["nodes"] == 4
+        parse_scenario(obj, env={})
+
+
+def test_per_size_spec_fixtures_are_valid():
+    import json
+
+    for n in SIZES:
+        path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "benchmark", "scenarios", f"fuzz_n{n}.spec.json",
+        )
+        with open(path) as f:
+            obj = json.load(f)
+        assert obj["nodes"] == n
+        s = parse_scenario(obj, env={})
+        assert s.byzantine and s.expect_rules
