@@ -12,7 +12,7 @@ PYTHON ?= python
 
 .PHONY: check native lint lint-invariants test test-ci metrics-smoke \
 	trace-smoke fault-smoke fault-fuzz-smoke trajectory race-explore \
-	sim-smoke sanitize bench clean
+	sim-smoke wire-ab-smoke sanitize bench clean
 
 check: native lint test
 
@@ -136,6 +136,18 @@ sim-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) benchmark/sim_bench.py \
 		--points 200 --artifact .ci-artifacts/sim-smoke.json --quiet
 
+# Paired interleaved wire-format A/B (ISSUE 13): legacy
+# (NARWHAL_WIRE_V2=0) vs v2 arms on a short 4-node local_bench,
+# ledger-read gates — v2 goodput_ratio >= 0.45 at committed TPS no
+# worse than the legacy arm (within the shared-host noise floor),
+# sender_coverage ≈ 1.0 and protocol_check within 5% on BOTH arms.
+# The before/after artifact is uploaded by the workflow.
+wire-ab-smoke:
+	mkdir -p .ci-artifacts
+	JAX_PLATFORMS=cpu $(PYTHON) benchmark/wire_ab.py \
+		--pairs 2 --duration 8 \
+		--artifact .ci-artifacts/wire-ab.json
+
 # Asyncio sanitizer tier (ISSUE 10): the fast concurrency-sensitive
 # tier-1 subset under `python -X dev` — asyncio debug mode with the
 # slow-callback threshold aligned to the PR 9 watchdog default
@@ -163,4 +175,4 @@ bench: native
 
 clean:
 	$(MAKE) -C native clean
-	rm -rf .bench .bench_remote .pytest_cache .ci-artifacts
+	rm -rf .bench .bench_remote .bench_wire_ab .pytest_cache .ci-artifacts

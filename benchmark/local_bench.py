@@ -203,6 +203,7 @@ def run_bench(
     progress_wait: float = 0.0,
     loop_watchdog_ms: int = 0,
     trace_out: str = None,
+    wire_v2: bool = None,
 ):
     """Run one committee + clients on localhost; return the ParseResult.
 
@@ -265,6 +266,12 @@ def run_bench(
         # JSON's `runtime` section joins them per node after the run.
         cpu_env["NARWHAL_LOOP_WATCHDOG_MS"] = str(loop_watchdog_ms)
         tpu_env["NARWHAL_LOOP_WATCHDOG_MS"] = str(loop_watchdog_ms)
+    if wire_v2 is not None:
+        # Paired wire-format A/B arm pin: the whole committee speaks one
+        # format (mixed-version committees are unsupported), so the flag
+        # goes to every child uniformly; None inherits the environment.
+        cpu_env["NARWHAL_WIRE_V2"] = "1" if wire_v2 else "0"
+        tpu_env["NARWHAL_WIRE_V2"] = "1" if wire_v2 else "0"
     procs = []
     primary_logs, worker_logs, client_logs = [], [], []
     metrics_paths = []
@@ -674,6 +681,23 @@ def main():
                     + (
                         f" (+{d['retransmit_bytes']:,} B retrans)"
                         if d["retransmit_bytes"]
+                        else ""
+                    )
+                )
+            if "compression_ratio" in result.wire:
+                print(
+                    f"   compression ratio: {result.wire['compression_ratio']}"
+                    f" (raw {totals.get('out_raw_bytes', 0):,} B"
+                    f" -> wire {totals.get('out_bytes', 0):,} B)"
+                )
+            if "frames_per_flush_mean" in result.wire:
+                print(
+                    f"   coalescing: {result.wire.get('flushes', 0):,}"
+                    " flushes, mean frames/flush "
+                    f"{result.wire['frames_per_flush_mean']}"
+                    + (
+                        f", mean acks/flush {result.wire['acks_per_flush_mean']}"
+                        if "acks_per_flush_mean" in result.wire
                         else ""
                     )
                 )

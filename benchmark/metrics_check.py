@@ -314,8 +314,13 @@ def round_attribution(snapshots: List[dict]) -> dict:
 # key) + 64 B (signature) on the wire; the embedded header adds one more
 # 64 B signature.  Certificates carry exactly quorum_threshold votes
 # (the VotesAggregator assembles at quorum and stops), so the signature
-# bytes of a cert frame are a pure function of the committee.
+# bytes of a cert frame are a pure function of the committee.  Under
+# wire v2 the voter key rides as a ~1 B committee index, so the
+# per-vote signature material is 64 B sig + 1 B ref; the fraction is
+# computed against the RAW (pre-compression) cert frame size in both
+# formats, so it keeps measuring frame anatomy, not deflate luck.
 _VOTE_WIRE_BYTES = 96
+_VOTE_WIRE_BYTES_V2 = 65
 _HEADER_SIG_BYTES = 64
 
 
@@ -391,15 +396,27 @@ def wire_crypto_summary(
 
     out_frames = typed("wire.out.frames.")
     out_bytes = typed("wire.out.bytes.")
+    out_raw = typed("wire.out.raw_bytes.")
     re_frames = typed("wire.out.retransmit_frames.")
     re_bytes = typed("wire.out.retransmit_bytes.")
     in_frames = typed("wire.in.frames.")
     in_bytes = typed("wire.in.bytes.")
 
+    # Which wire format the committee spoke (wire.format_version gauge,
+    # stamped by every node): drives the format-aware signature
+    # arithmetic below.  Max across nodes — the flag is committee-wide.
+    wire_version = 1
+    for snap in snapshots:
+        if snap.get("enabled", True):
+            v = (snap.get("gauges") or {}).get("wire.format_version")
+            if v:
+                wire_version = max(wire_version, int(v))
+
     types = sorted(
         set(out_bytes) | set(in_bytes) | set(re_bytes)
     )
     first_total = sum(out_bytes.values())
+    raw_total = sum(out_raw.values())
     re_total = sum(re_bytes.values())
     out_total = first_total + re_total
     in_total = sum(in_bytes.values())
@@ -407,12 +424,17 @@ def wire_crypto_summary(
         counters.get("net.reliable.bytes_sent", 0)
         + counters.get("net.simple.bytes_sent", 0)
     )
+    flushes = counters.get("wire.out.flushes", 0)
+    fpf_sum, fpf_count = hists.get("wire.out.frames_per_flush", (0.0, 0))
+    apf_sum, apf_count = hists.get("wire.out.acks_per_flush", (0.0, 0))
 
     wire: dict = {
+        "format_version": wire_version,
         "out": {
             t: {
                 "frames": int(out_frames.get(t, 0)),
                 "bytes": int(out_bytes.get(t, 0)),
+                "raw_bytes": int(out_raw.get(t, 0)),
                 "retransmit_frames": int(re_frames.get(t, 0)),
                 "retransmit_bytes": int(re_bytes.get(t, 0)),
             }
@@ -427,6 +449,7 @@ def wire_crypto_summary(
         },
         "totals": {
             "out_bytes": int(first_total),
+            "out_raw_bytes": int(raw_total),
             "out_retransmit_bytes": int(re_total),
             "out_bytes_total": int(out_total),
             "in_bytes": int(in_total),
@@ -456,10 +479,31 @@ def wire_crypto_summary(
         wire["goodput_ratio"] = round(
             committed_payload_bytes / out_total, 4
         )
-    cert_bytes = out_bytes.get("certificate", 0)
+        # Pre-compression logical bytes ÷ wire bytes (first transmissions
+        # only — raw counters don't track retransmits): >1 is the wire-v2
+        # compression win, 1.0 on the legacy arm.
+        if first_total > 0 and raw_total > 0:
+            wire["compression_ratio"] = round(raw_total / first_total, 4)
+    # Coalescing series (wire v2): syscall batching as a measured
+    # distribution, not an inference.  frames_per_flush covers the
+    # ReliableSender data path, acks_per_flush the receivers' replies.
+    if flushes:
+        wire["flushes"] = int(flushes)
+        if fpf_count:
+            wire["frames_per_flush_mean"] = round(fpf_sum / fpf_count, 3)
+        if apf_count:
+            wire["acks_per_flush_mean"] = round(apf_sum / apf_count, 3)
+    # Frame-anatomy metrics read the RAW (pre-compression) series so
+    # they measure encoding composition under both formats.
+    cert_bytes = out_raw.get("certificate", 0) or out_bytes.get(
+        "certificate", 0
+    )
     cert_frames = out_frames.get("certificate", 0)
     if quorum_weight and cert_frames:
-        sig_bytes = _VOTE_WIRE_BYTES * quorum_weight + _HEADER_SIG_BYTES
+        vote_wire = (
+            _VOTE_WIRE_BYTES_V2 if wire_version >= 2 else _VOTE_WIRE_BYTES
+        )
+        sig_bytes = vote_wire * quorum_weight + _HEADER_SIG_BYTES
         wire["cert_sig_bytes_per_cert"] = sig_bytes
         wire["cert_sig_bytes_fraction"] = round(
             sig_bytes / (cert_bytes / cert_frames), 4

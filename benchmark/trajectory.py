@@ -58,6 +58,10 @@ HIGHER_BETTER = {
     "consensus_tps",
     "vs_baseline",
     "goodput_ratio",
+    # Wire-v2 series (PR 13): syscall coalescing and byte compression —
+    # a drop means the coalescing got bypassed or the codec regressed.
+    "frames_per_flush_mean",
+    "compression_ratio",
 }
 LOWER_BETTER = {
     "consensus_latency_ms",
@@ -118,6 +122,8 @@ def _bench_result_metrics(d: dict) -> Dict[str, float]:
         "goodput_ratio",
         "cert_sig_bytes_fraction",
         "empty_cert_overhead_per_committed_byte",
+        "frames_per_flush_mean",
+        "compression_ratio",
     ):
         v = _num(d.get(key))
         if v is not None:
@@ -129,6 +135,8 @@ def _bench_result_metrics(d: dict) -> Dict[str, float]:
             "goodput_ratio",
             "cert_sig_bytes_fraction",
             "empty_cert_overhead_per_committed_byte",
+            "frames_per_flush_mean",
+            "compression_ratio",
         ):
             v = _num(wire.get(key))
             if v is not None:

@@ -1,0 +1,203 @@
+"""Paired interleaved wire-format A/B: legacy (NARWHAL_WIRE_V2=0) vs v2.
+
+The ROADMAP item 5 acceptance is purely ledger-read: goodput_ratio and
+per-type bytes/frame before vs after at equal committed TPS, with
+``sender_coverage ≈ 1.0`` and ``protocol_check`` inside its 5% gate on
+BOTH arms (the wire format must change bytes, never protocol
+arithmetic).  Arms are interleaved (legacy, v2, legacy, v2, ...) so
+slow host drift hits both equally — the r09/r10 A/B convention.
+
+    python benchmark/wire_ab.py --pairs 2 --duration 8 \
+        --artifact artifacts/wire_v2_r18.json
+
+Artifact shape: ``{"runs": [v2 bench results], "legacy_runs": [...],
+"summary": {...}}`` — ``runs`` carries only the v2 arm so
+benchmark/trajectory.py's median-of-runs loader reads this artifact as
+the v2 series point; the legacy arm rides under a key the loader
+ignores.  Exit status 1 when any run errored or the paired gates fail
+(goodput >= --min-goodput on v2, committed TPS no worse than
+--tps-tolerance below legacy, coverage/protocol checks on both arms).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmark.local_bench import run_bench  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _one_run(arm: str, idx: int, args) -> dict:
+    result = run_bench(
+        nodes=args.nodes,
+        workers=1,
+        rate=args.rate,
+        tx_size=args.tx_size,
+        duration=args.duration,
+        base_port=args.base_port,
+        workdir=os.path.join(REPO, ".bench_wire_ab"),
+        quiet=True,
+        progress_wait=args.progress_wait,
+        wire_v2=(arm == "v2"),
+    )
+    wire = result.wire or {}
+    return {
+        "arm": arm,
+        "run": idx,
+        "errors": result.errors,
+        "consensus_tps": result.consensus_tps,
+        "consensus_latency_ms": result.consensus_latency_ms,
+        "end_to_end_tps": result.end_to_end_tps,
+        "end_to_end_latency_ms": result.end_to_end_latency_ms,
+        "committed_bytes": result.committed_bytes,
+        "committed_batches": result.committed_batches,
+        "wire": wire,
+        "crypto": {
+            "protocol_check": (result.crypto or {}).get("protocol_check")
+        },
+    }
+
+
+def _per_type_frame_bytes(wire: dict) -> dict:
+    out = {}
+    for t, d in (wire.get("out") or {}).items():
+        if d.get("frames"):
+            out[t] = {
+                "frames": d["frames"],
+                "bytes_per_frame": round(d["bytes"] / d["frames"], 1),
+                "raw_bytes_per_frame": round(
+                    (d.get("raw_bytes") or d["bytes"]) / d["frames"], 1
+                ),
+            }
+    return out
+
+
+def _median(runs, key, default=0.0):
+    vals = [r.get(key) or 0.0 for r in runs]
+    return statistics.median(vals) if vals else default
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--pairs", type=int, default=2)
+    ap.add_argument("--nodes", type=int, default=4)
+    ap.add_argument("--rate", type=int, default=2_000)
+    ap.add_argument("--tx-size", type=int, default=512)
+    ap.add_argument("--duration", type=int, default=8)
+    ap.add_argument("--base-port", type=int, default=7800)
+    ap.add_argument("--progress-wait", type=float, default=30.0)
+    ap.add_argument("--min-goodput", type=float, default=0.45)
+    ap.add_argument(
+        "--tps-tolerance", type=float, default=0.25,
+        help="v2 median committed TPS may be at most this fraction below "
+        "the legacy arm's (shared-core hosts swing; equal-or-better is "
+        "the claim, this is the noise floor)",
+    )
+    ap.add_argument("--artifact", default="artifacts/wire_v2_r18.json")
+    args = ap.parse_args(argv)
+
+    runs_v2, runs_legacy = [], []
+    for i in range(args.pairs):
+        for arm, into in (("legacy", runs_legacy), ("v2", runs_v2)):
+            print(f"== wire A/B pair {i + 1}/{args.pairs}: {arm} arm ==")
+            r = _one_run(arm, i, args)
+            into.append(r)
+            print(
+                f"   committed TPS {r['consensus_tps']:,.0f}, goodput "
+                f"{r['wire'].get('goodput_ratio')}, coverage "
+                f"{(r['wire'].get('totals') or {}).get('sender_coverage')}"
+            )
+
+    failures = []
+    for r in runs_v2 + runs_legacy:
+        if r["errors"]:
+            failures.append(f"{r['arm']} run {r['run']}: {r['errors'][:3]}")
+        cov = (r["wire"].get("totals") or {}).get("sender_coverage")
+        if cov is None or abs(cov - 1.0) > 0.02:
+            failures.append(
+                f"{r['arm']} run {r['run']}: sender_coverage {cov}"
+            )
+        check = (r["crypto"] or {}).get("protocol_check") or {}
+        for kind in ("votes", "certificates"):
+            ratio = (check.get(kind) or {}).get("ratio")
+            if ratio is None or abs(ratio - 1.0) > 0.05:
+                failures.append(
+                    f"{r['arm']} run {r['run']}: protocol_check.{kind} "
+                    f"ratio {ratio}"
+                )
+
+    g_legacy = _median(
+        [r["wire"] for r in runs_legacy], "goodput_ratio"
+    )
+    g_v2 = _median([r["wire"] for r in runs_v2], "goodput_ratio")
+    tps_legacy = _median(runs_legacy, "consensus_tps")
+    tps_v2 = _median(runs_v2, "consensus_tps")
+    if g_v2 < args.min_goodput:
+        failures.append(
+            f"v2 median goodput {g_v2} < required {args.min_goodput}"
+        )
+    if tps_legacy and tps_v2 < tps_legacy * (1 - args.tps_tolerance):
+        failures.append(
+            f"v2 median committed TPS {tps_v2:,.0f} more than "
+            f"{args.tps_tolerance:.0%} below legacy {tps_legacy:,.0f}"
+        )
+
+    mid_v2 = sorted(runs_v2, key=lambda r: r["consensus_tps"])[
+        len(runs_v2) // 2
+    ]
+    mid_legacy = sorted(runs_legacy, key=lambda r: r["consensus_tps"])[
+        len(runs_legacy) // 2
+    ]
+    summary = {
+        "goodput_ratio": {"legacy": g_legacy, "v2": g_v2},
+        "consensus_tps": {"legacy": tps_legacy, "v2": tps_v2},
+        "compression_ratio_v2": mid_v2["wire"].get("compression_ratio"),
+        "frames_per_flush_mean_v2": mid_v2["wire"].get(
+            "frames_per_flush_mean"
+        ),
+        "acks_per_flush_mean_v2": mid_v2["wire"].get("acks_per_flush_mean"),
+        "per_type_frame_bytes": {
+            "legacy": _per_type_frame_bytes(mid_legacy["wire"]),
+            "v2": _per_type_frame_bytes(mid_v2["wire"]),
+        },
+        "gates_failed": failures,
+    }
+
+    artifact = {
+        "what": (
+            "Paired interleaved wire-format A/B (ISSUE 13): legacy "
+            "NARWHAL_WIRE_V2=0 vs v2 on a "
+            f"{args.nodes}-node local_bench, rate {args.rate}, "
+            f"{args.tx_size} B tx, {args.duration} s windows. `runs` is "
+            "the v2 arm (what the trajectory series reads); the legacy "
+            "arm is `legacy_runs`."
+        ),
+        "runs": runs_v2,
+        "legacy_runs": runs_legacy,
+        "summary": summary,
+    }
+    os.makedirs(os.path.dirname(args.artifact) or ".", exist_ok=True)
+    with open(args.artifact, "w") as f:
+        json.dump(artifact, f, indent=1)
+
+    print("== wire A/B summary ==")
+    print(json.dumps(summary, indent=1))
+    if failures:
+        print(f"wire A/B FAILED: {failures}", file=sys.stderr)
+        return 1
+    print(
+        f"wire A/B ok: goodput {g_legacy} -> {g_v2} at committed TPS "
+        f"{tps_legacy:,.0f} -> {tps_v2:,.0f}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

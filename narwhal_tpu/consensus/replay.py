@@ -43,6 +43,7 @@ prefix of the longest one.
 
 from __future__ import annotations
 
+import logging
 import os
 import struct
 from typing import Dict, List, Optional, Tuple
@@ -51,6 +52,58 @@ from ..config import Committee
 from ..messages import Round
 from ..primary.messages import Certificate, genesis
 from .golden import GoldenTusk
+
+log = logging.getLogger("narwhal.consensus")
+
+
+class _CertDecoder:
+    """Decode audit certificate payloads, sniffing the RECORDING's wire
+    arm: the nodes that wrote the segments may have run the other
+    ``NARWHAL_WIRE_V2`` arm than this (harness) process — e.g. auditing
+    a legacy-arm bench workdir under the default-on flag.  The first
+    payload that fails to decode under the process arm is retried under
+    the flipped arm; whichever works is pinned for the rest of the
+    replay (a recording is single-arm by construction — the flag is
+    committee-wide and process-constant)."""
+
+    __slots__ = ("arm",)
+
+    def __init__(self) -> None:
+        self.arm: Optional[bool] = None  # None = process flag untested
+
+    def __call__(self, payload: bytes) -> Certificate:
+        from ..network import wirev2
+
+        if self.arm is None:
+            try:
+                cert = Certificate.deserialize(payload)
+                self.arm = wirev2.enabled()
+                return cert
+            except Exception:
+                flipped = not wirev2.enabled()
+                prev = wirev2.enabled_override()
+                wirev2.set_enabled(flipped)
+                try:
+                    cert = Certificate.deserialize(payload)
+                finally:
+                    wirev2.set_enabled(prev)
+                log.warning(
+                    "audit replay: certificates decode under "
+                    "NARWHAL_WIRE_V2=%d, not this process's arm — the "
+                    "recording ran the other wire format; pinning it "
+                    "for this replay",
+                    1 if flipped else 0,
+                )
+                self.arm = flipped
+                return cert
+        if self.arm == wirev2.enabled():
+            return Certificate.deserialize(payload)
+        prev = wirev2.enabled_override()
+        wirev2.set_enabled(self.arm)
+        try:
+            return Certificate.deserialize(payload)
+        finally:
+            wirev2.set_enabled(prev)
 
 _LEN = struct.Struct("<I")
 
@@ -137,6 +190,13 @@ def replay_segments(
     docstring); ``ok`` is the conjunction of every check.  ``fixed_coin``
     must match the recording node's leader-election mode (live nodes:
     False; golden-test fixtures: True)."""
+    # The audit's certificate payloads use the wire-v2 key-index codec
+    # when the recording nodes ran v2 (the default): install the same
+    # roster in THIS (harness) process before deserializing.
+    from ..messages import set_wire_committee
+
+    set_wire_committee(committee)
+    decode_cert = _CertDecoder()
     genesis_digests = {c.digest() for c in genesis(committee)}
     violations: List[str] = []
     unverifiable_parents = 0
@@ -193,7 +253,7 @@ def replay_segments(
                 seg_seen.add(payload)
                 continue
             try:
-                cert = Certificate.deserialize(payload)
+                cert = decode_cert(payload)
             except Exception as exc:
                 # A complete 'I' record with a garbage payload (disk
                 # corruption, writer bug).  The segment's replay can no

@@ -265,12 +265,18 @@ class WireLedger:
     excluded from Prometheus):
     ``{"out"|"in": {type: {peer: [frames, bytes, re_frames, re_bytes]}}}``.
 
-    Counted bytes are frame PAYLOAD bytes (``len(data)``): the framing
-    length prefix and the tiny ACK replies are excluded on both sides,
-    so the two directions measure the same thing.
+    Counted bytes are frame PAYLOAD bytes as they ride the wire: the
+    framing length prefix and the tiny ACK replies are excluded on both
+    sides, so the two directions measure the same thing.  Under wire v2
+    the payload is COMPRESSED (per-connection digest references +
+    residual deflate), so every account also carries the frame's
+    pre-compression logical size into ``wire.<dir>.raw_bytes.<type>`` —
+    protocol-composition metrics (cert signature fraction, per-type
+    frame anatomy) read the raw series, goodput reads the wire series,
+    and their ratio is the measured compression win.
     """
 
-    __slots__ = ("registry", "peers", "_flat")
+    __slots__ = ("registry", "peers", "_flat", "_raw")
 
     def __init__(self, reg: "Registry") -> None:
         self.registry = reg
@@ -281,6 +287,8 @@ class WireLedger:
         }
         # (direction, type, retransmit) -> (frames Counter, bytes Counter)
         self._flat: Dict[Tuple[str, str, bool], Tuple[Counter, Counter]] = {}
+        # (direction, type) -> pre-compression bytes Counter
+        self._raw: Dict[Tuple[str, str], Counter] = {}
         if reg.enabled:
             reg.detail_fn("wire.peers", lambda: self.peers)
 
@@ -316,12 +324,21 @@ class WireLedger:
         peer: str,
         nbytes: int,
         retransmit: bool = False,
+        raw_nbytes: Optional[int] = None,
     ) -> None:
         if not self.registry.enabled:
             return
         frames, nbytes_c = self._counters(direction, msg_type, retransmit)
         frames.inc()
         nbytes_c.inc(nbytes)
+        if not retransmit:
+            key = (direction, msg_type)
+            raw_c = self._raw.get(key)
+            if raw_c is None:
+                raw_c = self._raw[key] = self.registry.counter(
+                    f"wire.{direction}.raw_bytes.{msg_type}"
+                )
+            raw_c.inc(nbytes if raw_nbytes is None else raw_nbytes)
         cell = (
             self.peers[direction]
             .setdefault(msg_type, {})
@@ -1382,10 +1399,15 @@ def wire_account(
     peer: str,
     nbytes: int,
     retransmit: bool = False,
+    raw_nbytes: Optional[int] = None,
 ) -> None:
     """Module-level convenience for the network layer (one call per
-    frame; no-op when the registry is stubbed)."""
-    _REGISTRY.wire.account(direction, msg_type, peer, nbytes, retransmit)
+    frame; no-op when the registry is stubbed).  ``raw_nbytes`` is the
+    frame's pre-compression size when wire v2 compressed it (defaults
+    to ``nbytes``)."""
+    _REGISTRY.wire.account(
+        direction, msg_type, peer, nbytes, retransmit, raw_nbytes
+    )
 
 
 def flight() -> FlightRecorder:

@@ -7,6 +7,7 @@ TCP — reference network/src/receiver.rs:70, simple_sender.rs:107.
 from __future__ import annotations
 
 import asyncio
+import random
 import struct
 
 _LEN = struct.Struct("<I")
@@ -67,9 +68,10 @@ def parse_address(addr: str):
     return host, int(port)
 
 
-def sample_peers(addresses, nodes: int):
-    """Pick `nodes` distinct random peers for lucky_broadcast."""
-    import random
-
+def sample_peers(addresses, nodes: int, rng: random.Random = random):  # type: ignore[assignment]
+    """Pick `nodes` distinct random peers for lucky_broadcast.  ``rng``
+    is injectable (the sim transport passes its seeded per-sender stream
+    so lucky sampling replays bit-identically per (seed, spec); socketed
+    senders default to the module RNG)."""
     addrs = list(addresses)
-    return random.sample(addrs, min(nodes, len(addrs)))
+    return rng.sample(addrs, min(nodes, len(addrs)))

@@ -13,9 +13,11 @@ from .. import metrics
 from ..utils.env import env_flag
 from ..utils.tasks import spawn
 from . import transport as _transport
+from . import wirev2
 from .framing import (
     STREAM_LIMIT,
     FrameError,
+    frame,
     parse_address,
     read_frame,
     tune_writer,
@@ -28,17 +30,71 @@ _m_frames_in = metrics.counter("net.recv.frames")
 _m_bytes_in = metrics.counter("net.recv.bytes")
 _m_bad_frames = metrics.counter("net.recv.bad_frames")
 
+# ACK-coalescing instruments (wire v2): replies written during one burst
+# of buffered inbound frames leave in ONE transport.write instead of one
+# syscall per ACK — votes and ACKs stop riding one syscall each.
+_m_ack_flushes = metrics.counter("wire.out.ack_flushes")
+_h_acks_per_flush = metrics.histogram("wire.out.acks_per_flush")
+
+# Backpressure floor for the coalesced reply path: replies are tiny, so
+# drain() (which can suspend the dispatch loop) is only awaited once
+# this much is buffered un-drained — a peer that stops reading ACKs
+# still bounds our buffer, without paying a drain per reply.
+_ACK_DRAIN_BYTES = 256 * 1024
+
 
 class Writer:
-    """Reply channel handed to the handler: writes frames back to the peer."""
+    """Reply channel handed to the handler: writes frames back to the peer.
 
-    __slots__ = ("_writer",)
+    Under wire v2 (``coalesce=True``) replies are buffered and flushed
+    with one ``transport.write`` per event-loop turn: a burst of inbound
+    frames dispatched back-to-back (the reader's buffer already held
+    them) accumulates its ACKs and the scheduled flush fires when the
+    loop next idles — the receiver-side mirror of the sender's
+    frame-coalescing.  The legacy arm keeps the one-write-plus-drain-
+    per-reply path byte- and syscall-identical."""
 
-    def __init__(self, writer: asyncio.StreamWriter) -> None:
+    __slots__ = ("_writer", "_buf", "_replies", "_scheduled", "_coalesce",
+                 "_undrained")
+
+    def __init__(
+        self, writer: asyncio.StreamWriter, coalesce: bool = False
+    ) -> None:
         self._writer = writer
+        self._coalesce = coalesce
+        self._buf = bytearray()
+        self._replies = 0
+        self._scheduled = False
+        self._undrained = 0
 
     async def send(self, data: bytes) -> None:
-        await write_frame(self._writer, data)
+        if not self._coalesce:
+            await write_frame(self._writer, data)
+            return
+        self._buf += frame(data)
+        self._replies += 1
+        if not self._scheduled:
+            self._scheduled = True
+            asyncio.get_running_loop().call_soon(self.flush)
+        if self._undrained >= _ACK_DRAIN_BYTES:
+            self.flush()
+            self._undrained = 0
+            await self._writer.drain()
+
+    def flush(self) -> None:
+        self._scheduled = False
+        if not self._buf:
+            return
+        if self._writer.is_closing():
+            self._buf.clear()
+            self._replies = 0
+            return
+        _m_ack_flushes.inc()
+        _h_acks_per_flush.observe(self._replies)
+        self._undrained += len(self._buf)
+        self._writer.write(bytes(self._buf))
+        self._buf.clear()
+        self._replies = 0
 
 
 class MessageHandler(Protocol):
@@ -121,17 +177,53 @@ class Receiver:
         # precise per-peer split.
         peer_ip = peer[0] if isinstance(peer, tuple) else str(peer)
         tune_writer(writer)
-        w = Writer(writer)
+        v2_capable = wirev2.enabled()
+        w = Writer(writer, coalesce=v2_capable)
+        # Per-connection wire-v2 state: a connection speaks v2 only after
+        # its first frame is the sender's HELLO (ReliableSender does;
+        # SimpleSender and legacy peers never do, and their raw frames
+        # keep working on the same listener).  The decode dictionary is
+        # connection state — a reconnect is a new connection, so stale
+        # back-references cannot survive a flap by construction.
+        v2_conn = False
+        dec_dict = None
+        first = True
         try:
             while True:
                 message = await read_frame(reader)
+                if first:
+                    first = False
+                    if v2_capable and message == wirev2.HELLO:
+                        v2_conn = True
+                        dec_dict = wirev2.DigestDict()
+                        _m_frames_in.inc()
+                        _m_bytes_in.inc(len(message))
+                        metrics.wire_account(
+                            "in", "wire_hello", peer_ip, len(message)
+                        )
+                        continue
+                wire_len = len(message)
+                if v2_conn:
+                    try:
+                        message = wirev2.decompress(message, dec_dict)
+                    except FrameError:
+                        # Typed into the ledger (the `frame_error` row of
+                        # wire.in.*), then the connection dies: a corrupt
+                        # or out-of-range reference means the dictionaries
+                        # may have diverged, and only a reconnect (which
+                        # resets both) is safe.
+                        metrics.wire_account(
+                            "in", "frame_error", peer_ip, wire_len
+                        )
+                        raise
                 _m_frames_in.inc()
-                _m_bytes_in.inc(len(message))
+                _m_bytes_in.inc(wire_len)
                 metrics.wire_account(
                     "in",
                     self.classify(message) if self.classify else "unframed",
                     peer_ip,
-                    len(message),
+                    wire_len,
+                    raw_nbytes=len(message),
                 )
                 await self.handler.dispatch(w, message)
         except (asyncio.IncompleteReadError, ConnectionError):
@@ -142,6 +234,7 @@ class Receiver:
         except Exception:
             log.exception("Handler error for peer %s", peer)
         finally:
+            w.flush()  # any coalesced replies still buffered
             writer.close()
             try:
                 await writer.wait_closed()

@@ -24,9 +24,11 @@ from ..faults import netem as _netem
 from ..utils.env import env_raw
 from ..utils.tasks import spawn
 from . import transport as _transport
+from . import wirev2
 from .framing import (
     MAX_FRAME,
     STREAM_LIMIT,
+    frame,
     parse_address,
     read_frame,
     sample_peers,
@@ -122,6 +124,25 @@ _m_bytes = metrics.counter("net.reliable.bytes_sent")
 _m_retrans = metrics.counter("net.reliable.retransmissions")
 _m_connect_fail = metrics.counter("net.reliable.connect_failures")
 _m_acks = metrics.counter("net.reliable.acks_received")
+
+# Wire-v2 coalescing instruments: one `flush` = one writer.write +
+# drain() covering every frame the per-connection buffer held at wakeup
+# (the Store.flush_deferred pattern applied to the socket).  The
+# histogram is the acceptance series — mean frames_per_flush > 1 IS the
+# syscall batching, measured, not inferred.
+_m_flushes = metrics.counter("wire.out.flushes")
+_h_frames_per_flush = metrics.histogram("wire.out.frames_per_flush")
+
+# One flush is bounded so a deep backlog cannot turn into an unbounded
+# buffered write (latency + memory): past this many payload bytes the
+# loop writes, drains, and immediately continues on the remainder.
+_FLUSH_MAX_BYTES = 1 << 20
+
+# Worst-case growth of a v2 container over its raw frame (tag + op
+# stream for every span a walker could legitimately yield); messages
+# within this distance of MAX_FRAME are refused on the v2 path rather
+# than risking a frame the receiver's cap would reject.
+_V2_HEADROOM = 64 * 1024
 
 # Live senders, for snapshot-time gauges: total un-ACKed backlog and how
 # many peer connections are currently in reconnect backoff.  WeakSet so a
@@ -295,31 +316,121 @@ class _Connection:
         """Pipeline writes from the buffer; match ACK frames FIFO."""
 
         loop = asyncio.get_running_loop()
+        v2 = wirev2.enabled()
 
         async def write_loop() -> None:
+            # Wire v2: announce the format, then speak compressed frames
+            # against a dictionary that lives and dies with THIS
+            # connection (reconnect = fresh dictionaries on both sides,
+            # so retransmitted frames re-encode and stale references
+            # cannot survive a flap).  The HELLO is not a protocol
+            # message: never in `pending`, never ACKed.
+            enc_dict = None
+            if v2:
+                enc_dict = wirev2.DigestDict()
+                writer.write(frame(wirev2.HELLO))
+                await writer.drain()
+                _m_bytes.inc(len(wirev2.HELLO))
+                metrics.wire_account(
+                    "out", "wire_hello", self.address, len(wirev2.HELLO)
+                )
             while True:
                 while self.buffer:
-                    item = self.buffer.popleft()
-                    if item.fut.cancelled():
+                    if not v2:
+                        # Legacy arm: byte- and syscall-identical to the
+                        # pre-v2 sender (one write_frame + drain per
+                        # message) — the paired A/B's baseline.
+                        item = self.buffer.popleft()
+                        if item.fut.cancelled():
+                            continue
+                        # Into `pending` BEFORE the await: if the write
+                        # (or this task) dies mid-frame, reconnect
+                        # retransmits it rather than losing the message
+                        # and wedging its future.
+                        item.t0 = loop.time()
+                        self.pending.append(item)
+                        # lint: allow-interleave(_requeue_pending only runs after _exchange's finally has cancelled AND awaited this task — "let cancellation unwind so neither loop touches the deques after we return" — so the buffer/pending writes it performs can never interleave with this suspended frame write; read_loop only popleft()s entries this loop appended before the suspension, which is exactly the ACK-FIFO contract)
+                        await write_frame(writer, item.data)
+                        # Counted after the write returns (same
+                        # convention as SimpleSender): a frame lost to a
+                        # mid-write disconnect is not "sent" — its
+                        # rewrite after reconnect is.
+                        _m_frames.inc()
+                        _m_bytes.inc(len(item.data))
+                        metrics.wire_account(
+                            "out", item.msg_type, self.address,
+                            len(item.data), retransmit=item.accounted,
+                        )
+                        item.accounted = True
                         continue
-                    # Into `pending` BEFORE the await: if the write (or this
-                    # task) dies mid-frame, reconnect retransmits it rather
-                    # than losing the message and wedging its future.
-                    item.t0 = loop.time()
-                    self.pending.append(item)
-                    await write_frame(writer, item.data)
-                    # Counted after the write returns (same convention as
-                    # SimpleSender): a frame lost to a mid-write disconnect
-                    # is not "sent" — its rewrite after reconnect is.
-                    _m_frames.inc()
-                    _m_bytes.inc(len(item.data))
-                    metrics.wire_account(
-                        "out", item.msg_type, self.address, len(item.data),
-                        retransmit=item.accounted,
-                    )
-                    item.accounted = True
+                    # v2: drain the WHOLE buffer into one multi-frame
+                    # write + a single drain().  Everything is staged in
+                    # `pending` before the await, and NOTHING is
+                    # accounted until the drain returns: a flush that
+                    # dies mid-stream charges zero first-transmission
+                    # bytes, and the eventual rewrite of each frame is
+                    # its (single) first transmission — the _Msg
+                    # accounting rules hold exactly, per frame, inside a
+                    # coalesced flush.
+                    blob = bytearray()
+                    wrote = []
+                    while self.buffer and len(blob) < _FLUSH_MAX_BYTES:
+                        item = self.buffer.popleft()
+                        if item.fut.cancelled():
+                            continue
+                        if len(item.data) > MAX_FRAME - _V2_HEADROOM:
+                            # An incompressible payload hugging the cap
+                            # could grow past MAX_FRAME under the
+                            # container overhead; the receiver would
+                            # reject it, killing the connection and
+                            # retransmitting the same poison frame
+                            # forever.  Rejected BEFORE compress() so
+                            # the dictionary is never mutated by a
+                            # frame the receiver won't see.
+                            if not item.fut.done():
+                                item.fut.set_exception(
+                                    ValueError(
+                                        f"message of {len(item.data)} "
+                                        "bytes cannot ride a v2 frame "
+                                        "within MAX_FRAME"
+                                    )
+                                )
+                            continue
+                        payload = wirev2.compress(
+                            item.data, item.msg_type, enc_dict
+                        )
+                        item.t0 = loop.time()
+                        self.pending.append(item)
+                        blob += frame(payload)
+                        wrote.append((item, len(payload)))
+                    if not wrote:
+                        continue
+                    writer.write(bytes(blob))
+                    await writer.drain()
+                    _m_flushes.inc()
+                    _h_frames_per_flush.observe(len(wrote))
+                    for item, nbytes in wrote:
+                        _m_frames.inc()
+                        _m_bytes.inc(nbytes)
+                        metrics.wire_account(
+                            "out", item.msg_type, self.address, nbytes,
+                            retransmit=item.accounted,
+                            raw_nbytes=len(item.data),
+                        )
+                        item.accounted = True
                 self.wakeup.clear()
                 await self.wakeup.wait()
+                if v2:
+                    # Micro-batch: one zero-delay yield before draining.
+                    # Everything already scheduled in this event-loop
+                    # pass (a burst being processed, a broadcast loop,
+                    # peers' frames just read) gets to push into the
+                    # buffer first, so the burst leaves as ONE flush.
+                    # Costs one ready-queue rotation — no timer, no
+                    # measurable latency — and is the difference between
+                    # frames_per_flush ~1 and the batched regime under
+                    # load.
+                    await asyncio.sleep(0)
 
         async def read_loop() -> None:
             while True:

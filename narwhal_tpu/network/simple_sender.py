@@ -15,8 +15,10 @@ from .. import metrics
 from ..faults import netem as _netem
 from ..utils.tasks import spawn
 from . import transport as _transport
+from . import wirev2
 from .framing import (
     STREAM_LIMIT,
+    frame,
     parse_address,
     read_frame,
     sample_peers,
@@ -31,6 +33,21 @@ _QUEUE_CAP = 1_000
 _m_frames = metrics.counter("net.simple.frames_sent")
 _m_bytes = metrics.counter("net.simple.bytes_sent")
 _m_dropped = metrics.counter("net.simple.dropped")
+
+# Shared with ReliableSender: one flush = one writer.write + drain()
+# covering every message the per-peer queue held at wakeup.  The
+# batch_digest plane (a worker's highest-frequency connection) rides
+# this sender, so its syscall batching lands in the same acceptance
+# series.
+_m_flushes = metrics.counter("wire.out.flushes")
+_h_frames_per_flush = metrics.histogram("wire.out.frames_per_flush")
+
+# Flush bounds, frames AND bytes: a deep backlog (the queue holds up to
+# 1000 messages, each up to ~500 KB batch frames on the worker Helper
+# path) must not turn into one multi-hundred-MB buffered write — same
+# rationale as ReliableSender's _FLUSH_MAX_BYTES.
+_FLUSH_MAX_FRAMES = 256
+_FLUSH_MAX_BYTES = 1 << 20
 
 
 class _Peer:
@@ -60,20 +77,54 @@ class _Peer:
             # Drain-and-discard replies (e.g. ACKs) so the peer's writes
             # don't stall; best-effort senders ignore response content.
             drain = spawn(self._drain(reader))
+            batch = []
             try:
                 while True:
-                    await write_frame(writer, data)
-                    # Counted only after the write succeeds; the failure
-                    # path below counts the in-flight message as dropped
-                    # (this sender's whole contract is visible loss).
-                    _m_frames.inc()
-                    _m_bytes.inc(len(data))
-                    metrics.wire_account(
-                        "out", msg_type, self.address, len(data)
-                    )
+                    if not wirev2.enabled():
+                        # Legacy arm: one write_frame + drain per message,
+                        # byte- and syscall-identical to the pre-v2 path.
+                        await write_frame(writer, data)
+                        # Counted only after the write succeeds; the
+                        # failure path below counts the in-flight message
+                        # as dropped (this sender's whole contract is
+                        # visible loss).
+                        _m_frames.inc()
+                        _m_bytes.inc(len(data))
+                        metrics.wire_account(
+                            "out", msg_type, self.address, len(data)
+                        )
+                        data, msg_type = await self.queue.get()
+                        continue
+                    # v2: one zero-delay yield (anything scheduled this
+                    # loop pass gets to enqueue), then drain the whole
+                    # queue into ONE write + drain().
+                    await asyncio.sleep(0)
+                    batch = [(data, msg_type)]
+                    nbytes = len(data)
+                    while (
+                        len(batch) < _FLUSH_MAX_FRAMES
+                        and nbytes < _FLUSH_MAX_BYTES
+                    ):
+                        try:
+                            item = self.queue.get_nowait()
+                        except asyncio.QueueEmpty:
+                            break
+                        batch.append(item)
+                        nbytes += len(item[0])
+                    writer.write(b"".join(frame(d) for d, _ in batch))
+                    await writer.drain()
+                    _m_flushes.inc()
+                    _h_frames_per_flush.observe(len(batch))
+                    for d, t in batch:
+                        _m_frames.inc()
+                        _m_bytes.inc(len(d))
+                        metrics.wire_account("out", t, self.address, len(d))
+                    batch = []
                     data, msg_type = await self.queue.get()
             except (ConnectionError, OSError) as e:
-                _m_dropped.inc()
+                # Every message of a failed coalesced flush is a visible
+                # drop, exactly like the single in-flight message was.
+                _m_dropped.inc(max(1, len(batch)))
                 log.debug("SimpleSender: lost %s: %s", self.address, e)
             finally:
                 drain.cancel()

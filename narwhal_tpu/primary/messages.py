@@ -21,7 +21,8 @@ from typing import Dict, List, Set, Tuple
 
 from ..config import Committee, WorkerId
 from ..crypto import Digest, PublicKey, Signature, digest32, verify, verify_batch
-from ..messages import Round
+from ..messages import Round, read_key_ref, skip_key_ref, write_key_ref
+from ..network import wirev2
 from ..utils.serde import Reader, Writer
 from .errors import (
     AuthorityReuse,
@@ -85,13 +86,28 @@ class Header:
             raise InvalidSignature(f"header {self.id!r}")
 
     def encode(self, w: Writer) -> None:
-        w.raw(self.author)
-        w.u64(self.round)
-        w.u32(len(self.payload))
-        for digest in sorted(self.payload):
-            w.raw(digest)
-            w.u32(self.payload[digest])
-        w.u32(len(self.parents))
+        # Wire v2 (NARWHAL_WIRE_V2, the default): committee-index key
+        # refs and varint rounds/counts — the header's only raw 32-byte
+        # material is its digests, which the per-connection dictionary
+        # then back-references.  The legacy body is the =0 A/B arm.
+        # Hashing preimages (compute_digest) are NOT touched by either:
+        # ids are flag-invariant.
+        if wirev2.enabled():
+            write_key_ref(w, self.author)
+            w.uvarint(self.round)
+            w.uvarint(len(self.payload))
+            for digest in sorted(self.payload):
+                w.raw(digest)
+                w.uvarint(self.payload[digest])
+            w.uvarint(len(self.parents))
+        else:
+            w.raw(self.author)
+            w.u64(self.round)
+            w.u32(len(self.payload))
+            for digest in sorted(self.payload):
+                w.raw(digest)
+                w.u32(self.payload[digest])
+            w.u32(len(self.parents))
         for parent in sorted(self.parents):
             w.raw(parent)
         w.raw(self.id)
@@ -99,13 +115,23 @@ class Header:
 
     @classmethod
     def decode(cls, r: Reader) -> "Header":
-        author = PublicKey(r.raw(32))
-        round = r.u64()
-        payload = {}
-        for _ in range(r.u32()):
-            d = Digest(r.raw(32))
-            payload[d] = r.u32()
-        parents = {Digest(r.raw(32)) for _ in range(r.u32())}
+        if wirev2.enabled():
+            author = read_key_ref(r)
+            round = r.uvarint()
+            payload = {}
+            for _ in range(r.uvarint()):
+                d = Digest(r.raw(32))
+                payload[d] = r.uvarint()
+            n_parents = r.uvarint()
+        else:
+            author = PublicKey(r.raw(32))
+            round = r.u64()
+            payload = {}
+            for _ in range(r.u32()):
+                d = Digest(r.raw(32))
+                payload[d] = r.u32()
+            n_parents = r.u32()
+        parents = {Digest(r.raw(32)) for _ in range(n_parents)}
         id_ = Digest(r.raw(32))
         signature = Signature(r.raw(64))
         return cls(author, round, payload, parents, id_, signature)
@@ -162,13 +188,26 @@ class Vote:
 
     def encode(self, w: Writer) -> None:
         w.raw(self.id)
-        w.u64(self.round)
-        w.raw(self.origin)
-        w.raw(self.author)
+        if wirev2.enabled():
+            w.uvarint(self.round)
+            write_key_ref(w, self.origin)
+            write_key_ref(w, self.author)
+        else:
+            w.u64(self.round)
+            w.raw(self.origin)
+            w.raw(self.author)
         w.raw(self.signature)
 
     @classmethod
     def decode(cls, r: Reader) -> "Vote":
+        if wirev2.enabled():
+            return cls(
+                Digest(r.raw(32)),
+                r.uvarint(),
+                read_key_ref(r),
+                read_key_ref(r),
+                Signature(r.raw(64)),
+            )
         return cls(
             Digest(r.raw(32)),
             r.u64(),
@@ -258,17 +297,30 @@ class Certificate:
 
     def encode(self, w: Writer) -> None:
         self.header.encode(w)
-        w.u32(len(self.votes))
-        for name, sig in self.votes:
-            w.raw(name)
-            w.raw(sig)
+        # v2: vote pubkeys ride as committee indices — ~1 byte instead
+        # of 32 per vote.  The 64-byte signatures remain; collapsing
+        # those is ROADMAP item 4 (aggregate certificates).
+        if wirev2.enabled():
+            w.uvarint(len(self.votes))
+            for name, sig in self.votes:
+                write_key_ref(w, name)
+                w.raw(sig)
+        else:
+            w.u32(len(self.votes))
+            for name, sig in self.votes:
+                w.raw(name)
+                w.raw(sig)
 
     @classmethod
     def decode(cls, r: Reader) -> "Certificate":
         header = Header.decode(r)
         votes = []
-        for _ in range(r.u32()):
-            votes.append((PublicKey(r.raw(32)), Signature(r.raw(64))))
+        if wirev2.enabled():
+            for _ in range(r.uvarint()):
+                votes.append((read_key_ref(r), Signature(r.raw(64))))
+        else:
+            for _ in range(r.u32()):
+                votes.append((PublicKey(r.raw(32)), Signature(r.raw(64))))
         return cls(header, votes)
 
     def serialize(self) -> bytes:
@@ -390,10 +442,16 @@ def encode_primary_message(obj) -> bytes:
 def encode_certificates_request(digests: List[Digest], requestor: PublicKey) -> bytes:
     w = Writer()
     w.u8(PM_CERTIFICATES_REQUEST)
-    w.u32(len(digests))
-    for d in digests:
-        w.raw(d)
-    w.raw(requestor)
+    if wirev2.enabled():
+        w.uvarint(len(digests))
+        for d in digests:
+            w.raw(d)
+        write_key_ref(w, requestor)
+    else:
+        w.u32(len(digests))
+        for d in digests:
+            w.raw(d)
+        w.raw(requestor)
     return w.finish()
 
 
@@ -444,10 +502,77 @@ def _decode_primary_message(data: bytes):
     elif tag == PM_CERTIFICATE:
         out = ("certificate", Certificate.decode(r))
     elif tag == PM_CERTIFICATES_REQUEST:
-        digests = [Digest(r.raw(32)) for _ in range(r.u32())]
-        requestor = PublicKey(r.raw(32))
+        if wirev2.enabled():
+            digests = [Digest(r.raw(32)) for _ in range(r.uvarint())]
+            requestor = read_key_ref(r)
+        else:
+            digests = [Digest(r.raw(32)) for _ in range(r.u32())]
+            requestor = PublicKey(r.raw(32))
         out = ("certificates_request", digests, requestor)
     else:
         raise ValueError(f"unknown PrimaryMessage tag {tag}")
     r.expect_done()
     return out
+
+
+# --- wire-v2 digest-span walkers (primary plane) -----------------------------
+#
+# Offsets of the 32-byte dictionary material in each v2-encoded frame,
+# for the per-connection reference compression (wirev2.register_spans;
+# best-effort by contract — a parse error means "no spans", never
+# corruption).  This is where the cert-broadcast repetition pays off: a
+# round's certificate re-carries its header's parents/payload digests
+# and id, all of which the same connection just shipped in the header
+# frame.
+
+
+def _header_body_spans(r: Reader, spans: List[int]) -> None:
+    skip_key_ref(r, spans)  # author (literal only for unknown keys)
+    r.uvarint()  # round
+    for _ in range(r.uvarint()):  # payload
+        spans.append(r.tell())
+        r.raw(32)
+        r.uvarint()
+    for _ in range(r.uvarint()):  # parents
+        spans.append(r.tell())
+        r.raw(32)
+    spans.append(r.tell())  # id
+    r.raw(32)
+    r.raw(64)  # signature: not dictionary material
+
+
+def _header_spans(data: bytes) -> List[int]:
+    r = Reader(data)
+    r.u8()
+    spans: List[int] = []
+    _header_body_spans(r, spans)
+    return spans
+
+
+def _vote_spans(data: bytes) -> List[int]:
+    r = Reader(data)
+    r.u8()
+    spans = [r.tell()]  # header id
+    r.raw(32)
+    r.uvarint()  # round
+    skip_key_ref(r, spans)  # origin
+    skip_key_ref(r, spans)  # author
+    return spans
+
+
+def _certificate_spans(data: bytes) -> List[int]:
+    r = Reader(data)
+    r.u8()
+    spans: List[int] = []
+    _header_body_spans(r, spans)
+    for _ in range(r.uvarint()):  # votes
+        skip_key_ref(r, spans)
+        r.raw(64)
+    return spans
+
+
+# cert_request frames ride SimpleSender (header_waiter), whose
+# connections stay on legacy framing — no walker registered for them.
+wirev2.register_spans("header", _header_spans)
+wirev2.register_spans("vote", _vote_spans)
+wirev2.register_spans("certificate", _certificate_spans)

@@ -20,6 +20,7 @@ from ..messages import (
     WORKER_PRIMARY_FRAME_TYPES,
     decode_worker_primary_message,
     frame_classifier,
+    set_wire_committee,
 )
 from ..network import Receiver, Writer
 from ..store import Store
@@ -110,6 +111,9 @@ class Primary:
         self = cls()
         name = keypair.name
         loop = asyncio.get_running_loop()
+        # Wire v2 key-index space: the committee roster, installed before
+        # any codec runs (store replay, receivers, proposer).
+        set_wire_committee(committee)
         q = lambda: asyncio.Queue(maxsize=CHANNEL_CAPACITY)  # noqa: E731
 
         tx_primaries = q()  # network → core

@@ -484,6 +484,10 @@ class _SimReliableSender:
         self.src = src
         self._serial = serial
         self._channels: Dict[str, _SimRelChannel] = {}
+        # Lucky-broadcast sampling draws from a seeded per-sender stream
+        # (not the module RNG) so peer selection replays bit-identically
+        # per (seed, spec).
+        self._lucky_rng = transport.pair_rng(src, "lucky", serial)
 
     def _channel(self, address: str) -> _SimRelChannel:
         chan = self._channels.get(address)
@@ -515,7 +519,10 @@ class _SimReliableSender:
     ) -> List[asyncio.Future]:
         from ..network.framing import sample_peers
 
-        return self.broadcast(sample_peers(addresses, nodes), data, msg_type)
+        return self.broadcast(
+            sample_peers(addresses, nodes, rng=self._lucky_rng),
+            data, msg_type,
+        )
 
     def close(self) -> None:
         for chan in self._channels.values():
@@ -532,6 +539,7 @@ class _SimSimpleSender:
         self.transport = transport
         self.src = src
         self._serial = serial
+        self._lucky_rng = transport.pair_rng(src, "lucky", serial)
         self._rngs: Dict[str, random.Random] = {}
         self._last_due: Dict[str, float] = {}
         self._inflight: Dict[str, Deque] = {}
@@ -592,7 +600,10 @@ class _SimSimpleSender:
     ) -> None:
         from ..network.framing import sample_peers
 
-        self.broadcast(sample_peers(addresses, nodes), data, msg_type)
+        self.broadcast(
+            sample_peers(addresses, nodes, rng=self._lucky_rng),
+            data, msg_type,
+        )
 
     def close(self) -> None:
         self._rngs.clear()

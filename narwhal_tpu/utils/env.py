@@ -80,6 +80,15 @@ _VARS = [
         "once-per-burst vote-log flush (round-cadence fast path, PR 5).",
     ),
     EnvVar(
+        "NARWHAL_WIRE_V2", "flag", True,
+        "Wire-format v2 master switch (per-peer frame coalescing, "
+        "per-connection digest-reference compression, compact varint/"
+        "key-index encodings, residual deflate). `0` is the byte-"
+        "identical legacy arm the paired wire A/B runs against; the "
+        "flag is committee-wide — mixed-version committees are not "
+        "supported.",
+    ),
+    EnvVar(
         "NARWHAL_NET_BACKOFF_MAX_S", "float", 60.0,
         "Reconnect-backoff ceiling in seconds (floor 0.2 s). Lower it "
         "for fault scenarios / latency-sensitive deployments so healed "

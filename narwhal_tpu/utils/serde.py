@@ -18,6 +18,17 @@ _U32 = struct.Struct("<I")
 _U64 = struct.Struct("<Q")
 
 
+def write_uvarint(buf: bytearray, v: int) -> None:
+    """LEB128 into an existing buffer — the one encoder loop shared by
+    :meth:`Writer.uvarint` and the wire-v2 op stream builder."""
+    if v < 0:
+        raise ValueError("uvarint: negative value")
+    while v >= 0x80:
+        buf.append((v & 0x7F) | 0x80)
+        v >>= 7
+    buf.append(v)
+
+
 class Writer:
     """Append-only byte sink."""
 
@@ -36,6 +47,14 @@ class Writer:
 
     def u64(self, v: int) -> "Writer":
         self._buf += _U64.pack(v)
+        return self
+
+    def uvarint(self, v: int) -> "Writer":
+        """Unsigned LEB128 — the wire-v2 width for rounds, counts and
+        lengths: protocol integers are tiny (rounds grow by one, counts
+        are committee-sized) so the fixed u32/u64 widths of the legacy
+        encoding are mostly zero bytes."""
+        write_uvarint(self._buf, v)
         return self
 
     def raw(self, b: bytes) -> "Writer":
@@ -77,6 +96,25 @@ class Reader:
 
     def u64(self) -> int:
         return _U64.unpack(self._take(8))[0]
+
+    def uvarint(self) -> int:
+        """Unsigned LEB128, capped at 64 bits so a hostile frame cannot
+        make the decoder build an unbounded integer."""
+        result = 0
+        shift = 0
+        while True:
+            b = self._take(1)[0]
+            result |= (b & 0x7F) << shift
+            if not b & 0x80:
+                return result
+            shift += 7
+            if shift > 63:
+                raise ValueError("serde: uvarint exceeds 64 bits")
+
+    def tell(self) -> int:
+        """Current decode offset (the wire-v2 digest-span walkers read
+        this to record field positions while parsing)."""
+        return self._pos
 
     def raw(self, n: int) -> bytes:
         return self._take(n)

@@ -24,6 +24,7 @@ from ..messages import (
     decode_primary_worker_message,
     decode_worker_message,
     frame_classifier,
+    set_wire_committee,
 )
 from ..network import Receiver, Writer
 from ..store import Store
@@ -208,6 +209,8 @@ class Worker:
         worker."""
         self = cls(name, worker_id, committee, parameters, store, benchmark)
         loop = asyncio.get_running_loop()
+        # Wire v2 key-index space (see Primary.spawn).
+        set_wire_committee(committee)
         q = lambda: asyncio.Queue(maxsize=CHANNEL_CAPACITY)  # noqa: E731
 
         # Byzantine wiring mirrors primary.py: same channels, same

@@ -157,10 +157,34 @@ def test_clean_local_bench_has_timeline_and_no_firing_rules(tmp_path):
     # and the final snapshot is written at SIGTERM — allow the tail.
     for t, ratio in wire["recv_vs_sent"].items():
         assert 0.85 <= ratio <= 1.01, (t, ratio, wire)
-    # Goodput ratio is reported and sane: committed payload can never
-    # exceed what went on the wire.
-    assert 0 < wire["goodput_ratio"] < 1, wire
+    # -- wire-format v2 gates (ISSUE 13) -------------------------------------
+    # Goodput: committed payload ÷ total wire bytes.  Pre-v2 this was
+    # structurally < 1 (broadcast amplification); with wire v2's
+    # residual deflate + digest references the wire side shrinks below
+    # the committed payload, so the CI-gated floor is 0.40 (the r12
+    # baseline was 0.24; a clean v2 run measures 2.5-4.5 on this
+    # workload) and there is deliberately no upper bound.
+    assert wire["goodput_ratio"] >= 0.40, wire
+    assert wire["format_version"] == 2, wire
+    # Compression actually engaged (raw vs wire bytes, first
+    # transmissions), and the signature-material fraction — computed
+    # against RAW frame bytes with the v2 per-vote arithmetic — stays a
+    # meaningful fraction.
+    assert wire["compression_ratio"] > 1.5, wire
     assert 0 < wire["cert_sig_bytes_fraction"] < 1, wire
+    # Coalescing is live, not bypassed: flushes are counted, and some
+    # flushes carried more than one frame (multi-frame evidence).  The
+    # strict mean-frames-per-flush > 1.5 gate lives on the tier-1
+    # in-process burst run (tests/test_wire_v2.py::
+    # test_coalesced_flush_batches_buffered_frames): on THIS bench's
+    # operating point the per-connection inter-frame gaps measure
+    # 20-100 ms (round-cadence paced, not bursty), so a >1.5 bench mean
+    # would require delaying protocol frames by tens of milliseconds —
+    # the wrong trade.  What is gated here: the histogram exists, every
+    # flush is counted, and batching happened.
+    assert wire["flushes"] > 0, wire
+    assert wire["frames_per_flush_mean"] > 1.0, wire
+    assert wire["acks_per_flush_mean"] >= 1.0, wire
 
     # -- loop-stall watchdog smoke arm (ISSUE 9 acceptance) ------------------
     # Every node ran with NARWHAL_LOOP_WATCHDOG_MS=100, so every
