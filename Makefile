@@ -12,7 +12,7 @@ PYTHON ?= python
 
 .PHONY: check native lint lint-invariants test test-ci metrics-smoke \
 	trace-smoke fault-smoke fault-fuzz-smoke trajectory race-explore \
-	sim-smoke wire-ab-smoke sanitize bench clean
+	sim-smoke wire-ab-smoke crypto-ab-smoke sanitize bench clean
 
 check: native lint test
 
@@ -148,6 +148,21 @@ wire-ab-smoke:
 		--pairs 2 --duration 8 \
 		--artifact .ci-artifacts/wire-ab.json
 
+# Paired interleaved crypto A/B (ISSUE 14): serial per-burst verify
+# (cpu backend, window off) vs the batched arm (verify-batch window on;
+# --batched-backend cpu on deviceless CI runners — the window deepening
+# is backend-independent, and the jax kernel's verdicts are covered by
+# tests/test_backend_differential.py).  Ledger-read gates: zero errors
+# + protocol_check within 5% on BOTH arms, and the batched arm's
+# crypto.verify.batch_size.batch_burst mean >= the serial arm's at
+# committed TPS no worse than the noise floor.
+crypto-ab-smoke:
+	mkdir -p .ci-artifacts
+	JAX_PLATFORMS=cpu $(PYTHON) benchmark/crypto_ab.py \
+		--pairs 2 --duration 8 --batched-backend cpu \
+		--min-batch-mean 0 \
+		--artifact .ci-artifacts/crypto-ab.json
+
 # Asyncio sanitizer tier (ISSUE 10): the fast concurrency-sensitive
 # tier-1 subset under `python -X dev` — asyncio debug mode with the
 # slow-callback threshold aligned to the PR 9 watchdog default
@@ -175,4 +190,5 @@ bench: native
 
 clean:
 	$(MAKE) -C native clean
-	rm -rf .bench .bench_remote .bench_wire_ab .pytest_cache .ci-artifacts
+	rm -rf .bench .bench_remote .bench_wire_ab .bench_crypto_ab \
+		.sim_crypto_ab .sim_wire_capture .pytest_cache .ci-artifacts

@@ -1,0 +1,245 @@
+"""Paired interleaved crypto A/B: serial per-burst verify vs the
+batched arm (ROADMAP item 1, the r09/r10 A/B methodology).
+
+Arms differ ONLY in the crypto plane:
+
+- **serial** — today's live path: ``--crypto-backend cpu`` with the
+  verify-batch window off (one ``averify_batch_mask`` per drained Core
+  burst; r12 measured mean batch 3.6).
+- **batched** — the deepened path: ``NARWHAL_VERIFY_BATCH_WINDOW_MS``
+  coalescing cross-message-type claims from multiple drains into one
+  backend dispatch through the pipelined Core verify stage, on the
+  backend picked by ``--batched-backend`` (``jax``/``tpu`` = the
+  device verifier; ``cpu`` = the same serial crypto in device-sized
+  batches — the arm for hosts where no chip is reachable and the
+  jax-cpu kernel measures slower than pure Python, the honest-verdict
+  fallback this repo's r06 kernel demotion set the precedent for).
+
+Arms are interleaved (serial, batched, serial, batched, ...) so slow
+host drift hits both equally.  Gates, all ledger-read:
+
+- zero run errors and ``protocol_check`` within 5% on BOTH arms (the
+  batching must change dispatch shape, never protocol arithmetic);
+- the batched arm's ``crypto.verify.batch_size.batch_burst`` mean must
+  be >= the serial arm's, and is compared against ``--min-batch-mean``
+  (default 16, the ISSUE 14 acceptance bar over the r12 baseline 3.6);
+- batched committed TPS no worse than serial beyond ``--tps-tolerance``.
+
+The artifact records both arms' crypto ledgers, the round_attribution
+verify legs (header_broadcast→first_vote and
+cert_broadcast→parent_quorum — the two peer-verify round-trip legs the
+r10 attribution blamed for 72-75% of the round period), and the gate
+verdicts.  Keys are ``serial_runs``/``batched_runs`` — deliberately NOT
+``runs`` so benchmark/trajectory.py does not read a fixed-rate A/B as a
+saturation-series point.
+
+    python benchmark/crypto_ab.py --pairs 2 --duration 10 \
+        --artifact artifacts/crypto_ab_r19.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmark.local_bench import run_bench  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# The two ROUND_STAGES legs that contain the peer signature-verify round
+# trips (r10 attribution): our header broadcast -> first peer vote back,
+# and our cert broadcast -> the parent quorum completing.
+VERIFY_LEGS = (
+    "header_broadcast_to_first_vote",
+    "cert_broadcast_to_parent_quorum",
+)
+
+
+def _one_run(arm: str, idx: int, args) -> dict:
+    batched = arm == "batched"
+    result = run_bench(
+        nodes=args.nodes,
+        workers=1,
+        rate=args.rate,
+        tx_size=args.tx_size,
+        duration=args.duration,
+        base_port=args.base_port,
+        workdir=os.path.join(REPO, ".bench_crypto_ab"),
+        quiet=True,
+        progress_wait=args.progress_wait,
+        crypto_backend=(args.batched_backend if batched else "cpu"),
+        verify_window_ms=(args.window_ms if batched else 0.0),
+    )
+    crypto = result.crypto or {}
+    burst = (crypto.get("verify") or {}).get("batch_burst") or {}
+    return {
+        "arm": arm,
+        "run": idx,
+        "errors": result.errors,
+        "consensus_tps": result.consensus_tps,
+        "consensus_latency_ms": result.consensus_latency_ms,
+        "end_to_end_tps": result.end_to_end_tps,
+        "end_to_end_latency_ms": result.end_to_end_latency_ms,
+        "committed_bytes": result.committed_bytes,
+        "batch_burst": burst,
+        "crypto": crypto,
+        "round_stages_ms": result.round_stages_ms,
+        "verify_legs_ms": {
+            leg: (result.round_stages_ms or {}).get(leg)
+            for leg in VERIFY_LEGS
+        },
+    }
+
+
+def _median(vals):
+    vals = [v for v in vals if v is not None]
+    return round(statistics.median(vals), 3) if vals else None
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--pairs", type=int, default=2)
+    ap.add_argument("--nodes", type=int, default=4)
+    ap.add_argument("--rate", type=int, default=3_000)
+    ap.add_argument("--tx-size", type=int, default=512)
+    ap.add_argument("--duration", type=int, default=10)
+    ap.add_argument("--base-port", type=int, default=7600)
+    ap.add_argument("--progress-wait", type=float, default=30.0)
+    ap.add_argument(
+        "--batched-backend", choices=["jax", "tpu", "cpu"], default="jax",
+        help="Backend for the batched arm: jax/tpu = the device "
+        "verifier (requires a reachable chip or a usably fast jax-cpu); "
+        "cpu = window-deepened serial crypto (deviceless hosts)",
+    )
+    ap.add_argument(
+        "--window-ms", type=float, default=25.0,
+        help="NARWHAL_VERIFY_BATCH_WINDOW_MS for the batched arm",
+    )
+    ap.add_argument(
+        "--min-batch-mean", type=float, default=16.0,
+        help="Required batched-arm crypto.verify.batch_size.batch_burst "
+        "mean (ISSUE 14 acceptance bar; r12 serial baseline 3.6)",
+    )
+    ap.add_argument(
+        "--tps-tolerance", type=float, default=0.25,
+        help="Batched median committed TPS may be at most this fraction "
+        "below serial (shared-core noise floor)",
+    )
+    ap.add_argument(
+        "--verdict-note", default=None,
+        help="Free-text honest-verdict note recorded as the artifact's "
+        "`host_verdict` (the r09/r10 convention for gates the host "
+        "cannot meet: say WHY, with the measurements)",
+    )
+    ap.add_argument("--artifact", default="artifacts/crypto_ab_r19.json")
+    args = ap.parse_args(argv)
+
+    runs = {"serial": [], "batched": []}
+    for i in range(args.pairs):
+        for arm in ("serial", "batched"):
+            print(f"== crypto A/B pair {i + 1}/{args.pairs}: {arm} arm ==")
+            r = _one_run(arm, i, args)
+            runs[arm].append(r)
+            print(
+                f"   committed TPS {r['consensus_tps']:,.0f}, "
+                f"batch_burst mean {r['batch_burst'].get('mean_batch')}, "
+                f"verify legs {r['verify_legs_ms']}"
+            )
+
+    failures = []
+    for r in runs["serial"] + runs["batched"]:
+        if r["errors"]:
+            failures.append(f"{r['arm']} run {r['run']}: {r['errors'][:3]}")
+        check = (r["crypto"] or {}).get("protocol_check") or {}
+        for kind in ("votes", "certificates"):
+            ratio = (check.get(kind) or {}).get("ratio")
+            if ratio is None or abs(ratio - 1.0) > 0.05:
+                failures.append(
+                    f"{r['arm']} run {r['run']}: protocol_check.{kind} "
+                    f"ratio {ratio}"
+                )
+
+    mean_serial = _median(
+        [r["batch_burst"].get("mean_batch") for r in runs["serial"]]
+    )
+    mean_batched = _median(
+        [r["batch_burst"].get("mean_batch") for r in runs["batched"]]
+    )
+    tps_serial = _median([r["consensus_tps"] for r in runs["serial"]])
+    tps_batched = _median([r["consensus_tps"] for r in runs["batched"]])
+    if mean_serial is None or mean_batched is None:
+        failures.append("batch_burst mean missing from an arm's ledger")
+    else:
+        if mean_batched < mean_serial:
+            failures.append(
+                f"batched batch_burst mean {mean_batched} < serial "
+                f"{mean_serial} — the window did not deepen bursts"
+            )
+        if mean_batched < args.min_batch_mean:
+            failures.append(
+                f"batched batch_burst mean {mean_batched} < required "
+                f"{args.min_batch_mean}"
+            )
+    if tps_serial and tps_batched is not None and (
+        tps_batched < tps_serial * (1 - args.tps_tolerance)
+    ):
+        failures.append(
+            f"batched median committed TPS {tps_batched:,.0f} more than "
+            f"{args.tps_tolerance:.0%} below serial {tps_serial:,.0f}"
+        )
+
+    summary = {
+        "batched_backend": args.batched_backend,
+        "window_ms": args.window_ms,
+        "batch_burst_mean": {"serial": mean_serial, "batched": mean_batched},
+        "consensus_tps": {"serial": tps_serial, "batched": tps_batched},
+        "verify_legs_ms": {
+            arm: {
+                leg: _median(
+                    [r["verify_legs_ms"].get(leg) for r in arm_runs]
+                )
+                for leg in VERIFY_LEGS
+            }
+            for arm, arm_runs in runs.items()
+        },
+        "gates_failed": failures,
+    }
+
+    artifact = {
+        "what": (
+            "Paired interleaved crypto A/B (ISSUE 14): serial per-burst "
+            "verify (cpu backend, window off) vs the batched arm "
+            f"(backend {args.batched_backend}, "
+            f"NARWHAL_VERIFY_BATCH_WINDOW_MS={args.window_ms}) on a "
+            f"{args.nodes}-node local_bench, rate {args.rate}, "
+            f"{args.tx_size} B tx, {args.duration} s windows."
+        ),
+        "serial_runs": runs["serial"],
+        "batched_runs": runs["batched"],
+        "summary": summary,
+    }
+    if args.verdict_note:
+        artifact["host_verdict"] = args.verdict_note
+    os.makedirs(os.path.dirname(args.artifact) or ".", exist_ok=True)
+    with open(args.artifact, "w") as f:
+        json.dump(artifact, f, indent=1)
+
+    print("== crypto A/B summary ==")
+    print(json.dumps(summary, indent=1))
+    if failures:
+        print(f"crypto A/B FAILED: {failures}", file=sys.stderr)
+        return 1
+    print(
+        f"crypto A/B ok: batch_burst mean {mean_serial} -> {mean_batched} "
+        f"at committed TPS {tps_serial:,.0f} -> {tps_batched:,.0f}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -204,6 +204,7 @@ def run_bench(
     loop_watchdog_ms: int = 0,
     trace_out: str = None,
     wire_v2: bool = None,
+    verify_window_ms: float = None,
 ):
     """Run one committee + clients on localhost; return the ParseResult.
 
@@ -272,6 +273,12 @@ def run_bench(
         # goes to every child uniformly; None inherits the environment.
         cpu_env["NARWHAL_WIRE_V2"] = "1" if wire_v2 else "0"
         tpu_env["NARWHAL_WIRE_V2"] = "1" if wire_v2 else "0"
+    if verify_window_ms is not None:
+        # Verify-batch accumulation window (crypto A/B batched arm):
+        # every primary coalesces drained bursts into one backend
+        # dispatch within this window; None inherits the environment.
+        cpu_env["NARWHAL_VERIFY_BATCH_WINDOW_MS"] = str(verify_window_ms)
+        tpu_env["NARWHAL_VERIFY_BATCH_WINDOW_MS"] = str(verify_window_ms)
     procs = []
     primary_logs, worker_logs, client_logs = [], [], []
     metrics_paths = []
@@ -295,10 +302,12 @@ def run_bench(
 
     # Device-requiring flags go only to the TPU-designated primaries; any
     # other explicitly requested flag (e.g. --crypto-backend cpu) goes to
-    # every node unconditionally.
+    # every node unconditionally.  "jax" counts as a device flag too —
+    # it may resolve to jax-cpu (the A/B fallback arm) but still pays
+    # XLA warmup at boot, so it gets the same prewarm + long deadline.
     base_flags, device_flags = [], []
-    if crypto_backend == "tpu":
-        device_flags += ["--crypto-backend", "tpu"]
+    if crypto_backend in ("tpu", "jax"):
+        device_flags += ["--crypto-backend", crypto_backend]
     elif crypto_backend:
         base_flags += ["--crypto-backend", crypto_backend]
     if consensus_kernel:
@@ -327,10 +336,13 @@ def run_bench(
         ]
         if consensus_kernel:
             warm_cmd.append("--experimental-consensus-kernel")
-        if crypto_backend != "tpu":
+        if crypto_backend not in ("tpu", "jax"):
             # Consensus-kernel-only run: the nodes keep CPU crypto, so
             # compiling the verify shapes would be pure waste.
             warm_cmd.append("--skip-verify")
+        # tpu_env already carries the verify-window knob, so the prewarm
+        # subprocess sizes its shapes from the same env the committee
+        # will run under (derive_max_claims reads the window knobs).
         warm = subprocess.run(warm_cmd, env=tpu_env, cwd=REPO, check=False)
         if warm.returncode != 0:
             # Loud but non-fatal: the nodes will still try to boot (their
@@ -578,7 +590,19 @@ def main():
         "flight instants, sampled-CPU track) to this path — see "
         "benchmark/trace_export.py",
     )
-    parser.add_argument("--crypto-backend", choices=["cpu", "tpu"], default=None)
+    parser.add_argument(
+        "--crypto-backend", choices=["cpu", "tpu", "jax"], default=None,
+        help="Primary verification backend: jax/tpu run the batched "
+        "device verifier (jax works on jax-cpu for the A/B fallback "
+        "arm); default inherits NARWHAL_CRYPTO_BACKEND, else cpu",
+    )
+    parser.add_argument(
+        "--verify-window-ms", type=float, default=None,
+        help="Verify-batch accumulation window for every primary "
+        "(NARWHAL_VERIFY_BATCH_WINDOW_MS): coalesce drained bursts "
+        "arriving within this many ms into one backend dispatch; "
+        "unset inherits the environment (default off)",
+    )
     parser.add_argument(
         "--experimental-consensus-kernel",
         dest="consensus_kernel",
@@ -611,6 +635,7 @@ def main():
         tpu_primaries=args.tpu_primaries,
         loop_watchdog_ms=args.loop_watchdog_ms,
         trace_out=args.trace_out,
+        verify_window_ms=args.verify_window_ms,
     )
     if result.errors:
         print("ERRORS detected in logs:", file=sys.stderr)
@@ -714,9 +739,14 @@ def main():
         if result.crypto:
             print(" + CRYPTO LEDGER (verify ops by call site):")
             for site, d in result.crypto.get("verify", {}).items():
+                split = (
+                    f", {d['compute_s']:.2f} s compute"
+                    if "compute_s" in d
+                    else ""
+                )
                 print(
                     f"   {site}: {d['ops']:,} ops / {d['calls']:,} calls"
-                    f" / {d['wall_s']:.2f} s wall"
+                    f" / {d['wall_s']:.2f} s wall{split}"
                     f" (mean batch {d['mean_batch']})"
                 )
             cache = result.crypto.get("verify_cache", {})

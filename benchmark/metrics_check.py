@@ -529,12 +529,25 @@ def wire_crypto_summary(
         bsum, bcount = hists.get(
             f"crypto.verify.batch_size.{site}", (0.0, 0)
         )
+        # Async batched path only: backend compute time (host prep +
+        # device round trip) vs the wall histogram above, which also
+        # carries event-loop yields/executor-queue wait across the
+        # await — the split that stops pipelining reading as crypto
+        # cost (wall >> compute means the loop overlapped other work).
+        dev_s, dev_calls = hists.get(
+            f"crypto.verify.device_seconds.{site}", (0.0, 0)
+        )
         verify_sites[site] = {
             "ops": int(ops),
             "calls": int(calls),
             "wall_s": round(wall_s, 3),
             "mean_batch": round(bsum / bcount, 2) if bcount else None,
         }
+        if dev_calls:
+            verify_sites[site]["compute_s"] = round(dev_s, 3)
+            verify_sites[site]["loop_overlap_s"] = round(
+                max(0.0, wall_s - dev_s), 3
+            )
     sign_sites: dict = {}
     for site, ops in sorted(typed("crypto.sign.ops.").items()):
         wall_s, _calls = hists.get(f"crypto.sign.seconds.{site}", (0.0, 0))

@@ -9,12 +9,16 @@ a vmapped JAX verifier (narwhal_tpu/ops/ed25519.py) in one dispatch.
 
 from __future__ import annotations
 
+import logging
 import time
-from typing import Dict, List, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from .. import metrics
+from ..utils.env import env_flag, env_str
 from .digest import Digest
 from .keys import PublicKey, Signature, cpu_verify
+
+log = logging.getLogger("narwhal.crypto")
 
 # -- crypto-cost ledger -------------------------------------------------------
 #
@@ -34,7 +38,13 @@ from .keys import PublicKey, Signature, cpu_verify
 # batched path this includes event-loop yields/device round-trip, which
 # is exactly the latency the caller pays), and
 # `crypto.verify.batch_size.<site>` (ops per call — the serial→batched
-# conversion shows up as mass moving to higher buckets).
+# conversion shows up as mass moving to higher buckets).  The async
+# batched path additionally records
+# `crypto.verify.device_seconds.<site>`: the backend's own compute time
+# (host prep + device dispatch + result sync), EXCLUDING event-loop
+# yield/executor-queue time — without the split, the single wall
+# histogram conflates "crypto is slow" with "the loop was busy", which
+# under-credits pipelining in the A/B.
 # Instrumentation lives HERE, on the module seam, so both the CPU and
 # TPU backends are covered and backend-internal chunking is not
 # double-counted.
@@ -51,6 +61,7 @@ def _verify_instruments(site: str):
             metrics.histogram(
                 f"crypto.verify.batch_size.{site}", metrics.COUNT_BUCKETS
             ),
+            metrics.histogram(f"crypto.verify.device_seconds.{site}"),
         )
     return inst
 
@@ -81,40 +92,93 @@ class CpuBackend:
         keys: Sequence[PublicKey],
         sigs: Sequence[Signature],
     ) -> List[bool]:
+        mask, _ = await self.averify_batch_mask_timed(messages, keys, sigs)
+        return mask
+
+    async def averify_batch_mask_timed(
+        self,
+        messages: Sequence[bytes],
+        keys: Sequence[PublicKey],
+        sigs: Sequence[Signature],
+    ) -> Tuple[List[bool], float]:
+        """(mask, compute_seconds): compute time sums the synchronous
+        verify chunks only — the inter-chunk event-loop yields are wall
+        time the CALLER'S latency pays, not crypto cost."""
         n = len(messages)
+        t0 = time.perf_counter()
         if n <= self.AVERIFY_CHUNK:
-            return self.verify_batch_mask(messages, keys, sigs)
+            return (
+                self.verify_batch_mask(messages, keys, sigs),
+                time.perf_counter() - t0,
+            )
         import asyncio
 
         out: List[bool] = []
+        compute = 0.0
         for i in range(0, n, self.AVERIFY_CHUNK):
             j = i + self.AVERIFY_CHUNK
+            t0 = time.perf_counter()
             out.extend(self.verify_batch_mask(messages[i:j], keys[i:j], sigs[i:j]))
+            compute += time.perf_counter() - t0
             # Yield between chunks so network/timers keep running during a
             # committee-sized burst (tens of ms of crypto at N=20+).
             await asyncio.sleep(0)
-        return out
+        return out, compute
 
 
 _backend = CpuBackend()
 
+# The batched JAX verifier runs on whatever platform JAX has — a real
+# TPU or the jax-cpu mesh (the A/B fallback arm) — so "jax" is the
+# honest spelling; "tpu" is kept as the historical alias.
+_BATCHED_NAMES = ("tpu", "jax")
 
-def set_backend(name: str) -> None:
-    """Select the verification backend: "cpu" or "tpu"."""
+
+def set_backend(name: str, strict: Optional[bool] = None) -> None:
+    """Select the verification backend: "cpu", or "jax"/"tpu" (the
+    batched device verifier).
+
+    A jax/tpu request whose import fails is a BOOT error, not a
+    first-burst error: with ``strict`` (default: the
+    NARWHAL_CRYPTO_BACKEND_STRICT flag, on) the import failure raises
+    here, at selection time; with strict off it logs the import error
+    and falls back to the cpu backend — an explicit, logged downgrade.
+    """
     global _backend
     if name == "cpu":
         _backend = CpuBackend()
-    elif name == "tpu":
+    elif name in _BATCHED_NAMES:
         try:
             from ..ops.ed25519 import TpuBackend  # deferred: JAX import is heavy
         except ImportError as e:
-            raise NotImplementedError(
-                "TPU crypto backend requires narwhal_tpu.ops.ed25519 "
-                f"(import failed: {e})"
-            ) from e
+            if strict is None:
+                strict = env_flag("NARWHAL_CRYPTO_BACKEND_STRICT")
+            if strict:
+                raise RuntimeError(
+                    f"crypto backend {name!r} requested but the batched "
+                    f"verifier failed to import: {e} — install jax/numpy "
+                    "or set NARWHAL_CRYPTO_BACKEND_STRICT=0 to fall back "
+                    "to the cpu backend"
+                ) from e
+            log.error(
+                "crypto backend %r unavailable (%s); falling back to cpu "
+                "(NARWHAL_CRYPTO_BACKEND_STRICT=0)", name, e,
+            )
+            _backend = CpuBackend()
+            return
         _backend = TpuBackend()
     else:
         raise ValueError(f"unknown crypto backend {name!r}")
+
+
+def set_backend_from_env(cli_choice: Optional[str] = None) -> str:
+    """Boot-time backend selection: the CLI flag wins, then the
+    NARWHAL_CRYPTO_BACKEND env knob, then "cpu".  Returns the name that
+    was requested (the live backend's name may differ only under the
+    non-strict fallback)."""
+    name = cli_choice or env_str("NARWHAL_CRYPTO_BACKEND") or "cpu"
+    set_backend(name)
+    return name
 
 
 def get_backend():
@@ -124,7 +188,7 @@ def get_backend():
 def verify(
     message: bytes, key: PublicKey, sig: Signature, site: str = "other"
 ) -> bool:
-    ops, secs, sizes = _verify_instruments(site)
+    ops, secs, sizes, _dev = _verify_instruments(site)
     t0 = time.perf_counter()
     try:
         return _backend.verify(message, key, sig)
@@ -145,7 +209,7 @@ def verify_batch_mask(
         raise ValueError("verify_batch: length mismatch")
     if not messages:
         return []
-    ops, secs, sizes = _verify_instruments(site)
+    ops, secs, sizes, _dev = _verify_instruments(site)
     t0 = time.perf_counter()
     try:
         return list(_backend.verify_batch_mask(messages, keys, sigs))
@@ -169,10 +233,17 @@ async def averify_batch_mask(
         raise ValueError("verify_batch: length mismatch")
     if not messages:
         return []
-    ops, secs, sizes = _verify_instruments(site)
+    ops, secs, sizes, dev = _verify_instruments(site)
     t0 = time.perf_counter()
     try:
-        return list(await _backend.averify_batch_mask(messages, keys, sigs))
+        mask, compute_s = await _backend.averify_batch_mask_timed(
+            messages, keys, sigs
+        )
+        # Backend-side compute only (host prep + dispatch + result sync)
+        # vs the wall observation below, which additionally carries the
+        # event-loop yields / executor-queue wait across the await.
+        dev.observe(compute_s)
+        return list(mask)
     finally:
         # Wall time across the await: includes event-loop yields and the
         # device round trip — the latency the calling burst actually pays.

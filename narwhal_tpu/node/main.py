@@ -135,9 +135,13 @@ def main(argv=None) -> int:
     )
     run.add_argument(
         "--crypto-backend",
-        choices=["cpu", "tpu"],
+        choices=["cpu", "tpu", "jax"],
         default=None,
-        help="Signature verification backend (default: cpu)",
+        help="Signature verification backend: cpu (serial) or jax/tpu "
+        "(the batched device verifier — `jax` runs on whatever platform "
+        "JAX has, incl. jax-cpu).  Default: the NARWHAL_CRYPTO_BACKEND "
+        "env knob, else cpu.  A jax/tpu request that cannot import "
+        "fails AT BOOT unless NARWHAL_CRYPTO_BACKEND_STRICT=0.",
     )
     run.add_argument(
         "--metrics-path",
@@ -262,10 +266,20 @@ def main(argv=None) -> int:
         Parameters.load(args.parameters) if args.parameters else Parameters()
     )
     parameters.log(logging.getLogger("narwhal.node"))
-    if args.crypto_backend:
-        from ..crypto import backend as crypto_backend
+    # Crypto backend selection happens HERE, at boot (CLI flag, else the
+    # NARWHAL_CRYPTO_BACKEND env knob, else cpu): a jax/tpu request whose
+    # import fails raises NOW with the import error instead of deep in
+    # the first verify burst (NARWHAL_CRYPTO_BACKEND_STRICT=0 downgrades
+    # that to a logged cpu fallback).  The warmup that pre-compiles the
+    # burst shapes runs in spawn_primary_node, against whatever backend
+    # this call selected.
+    from ..crypto import backend as crypto_backend
 
-        crypto_backend.set_backend(args.crypto_backend)
+    requested = crypto_backend.set_backend_from_env(args.crypto_backend)
+    logging.getLogger("narwhal.node").info(
+        "Crypto backend: %s (requested %s)",
+        crypto_backend.get_backend().name, requested,
+    )
 
     async def run_node() -> None:
         # Graceful SIGTERM: set the stop event from the loop (raising out of

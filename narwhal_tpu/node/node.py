@@ -28,14 +28,20 @@ CHANNEL_CAPACITY = 1_000
 
 
 def derive_max_claims(committee: Committee) -> int:
-    """Largest claim batch a Core burst can produce: DRAIN_LIMIT items,
+    """Largest claim batch a Core burst can produce: the max messages
+    per verify dispatch (DRAIN_LIMIT, or NARWHAL_VERIFY_BATCH_MAX when
+    the accumulation window coalesces several drains into one dispatch),
     each a certificate carrying its header claim plus one quorum of vote
     claims.  Worst case is the LARGEST vote set that can form a quorum
     (smallest stakes first), not the smallest.  Shared between node boot
     and the bench harness's device pre-warm step so both compile exactly
     the same pad shapes."""
     from ..primary.core import Core
+    from ..utils.env import env_float, env_int
 
+    max_items = Core.DRAIN_LIMIT
+    if env_float("NARWHAL_VERIFY_BATCH_WINDOW_MS") > 0:
+        max_items = max(max_items, env_int("NARWHAL_VERIFY_BATCH_MAX"))
     stakes = sorted(a.stake for a in committee.authorities.values())
     acc, worst_votes = 0, 0
     for s in stakes:
@@ -43,7 +49,7 @@ def derive_max_claims(committee: Committee) -> int:
         worst_votes += 1
         if acc >= committee.quorum_threshold():
             break
-    return Core.DRAIN_LIMIT * (worst_votes + 1)
+    return max_items * (worst_votes + 1)
 
 
 class PrimaryNode:

@@ -36,7 +36,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..utils.env import env_str
+from ..utils.env import env_flag, env_str
 from . import field25519 as F
 
 P = F.P
@@ -371,18 +371,75 @@ def prepare_batch(
     )
 
 
+# -- multi-device mesh (stretch, NARWHAL_VERIFY_MESH) -------------------------
+#
+# The kernel is elementwise over the batch axis, so sharding is trivial:
+# a 1-D Mesh over every visible device, shard_map splitting the batch
+# (pad shapes are powers of two ≥ 16 and device counts are powers of two
+# on every real topology, so the split is always even — a non-dividing
+# count falls back to the single-device kernel rather than re-padding).
+# Throughput then scales with chips, not cores (SNIPPETS.md [1-3], the
+# t5x/Tenstorrent mesh exemplars).
+
+_mesh_kernel_cache: dict = {}
+
+
+def _mesh_verify_kernel(n_dev: int):
+    """shard_map-wrapped _verify_kernel over an ``n_dev``-device mesh;
+    built once per device count (the wrapped fn keeps the jit cache)."""
+    fn = _mesh_kernel_cache.get(n_dev)
+    if fn is None:
+        from jax.sharding import Mesh, PartitionSpec as P_
+        try:  # moved out of experimental in newer JAX
+            from jax.experimental.shard_map import shard_map
+        except ImportError:  # pragma: no cover - version skew
+            from jax.shard_map import shard_map
+        mesh = Mesh(np.array(jax.devices()), ("batch",))
+        spec = P_("batch")
+        fn = jax.jit(
+            shard_map(
+                _verify_kernel.__wrapped__,  # the un-jitted kernel
+                mesh=mesh,
+                in_specs=(spec,) * 9,
+                out_specs=spec,
+            )
+        )
+        _mesh_kernel_cache[n_dev] = fn
+    return fn
+
+
+def mesh_devices() -> int:
+    """How many devices a mesh-sharded verify would span: >1 only when
+    the NARWHAL_VERIFY_MESH flag is on and JAX sees several devices."""
+    if not env_flag("NARWHAL_VERIFY_MESH"):
+        return 1
+    try:
+        return len(jax.devices())
+    except RuntimeError:  # no backend initialized / unreachable
+        return 1
+
+
 def verify_batch_arrays(messages, keys, sigs) -> np.ndarray:
     """Bool mask for a batch of (message, key, signature) triples.  The
     batch is padded to a power of two ≥ 16 so XLA compiles a small set of
-    shapes (cached across calls)."""
+    shapes (cached across calls).  With NARWHAL_VERIFY_MESH and several
+    visible devices, the padded batch is sharded across the device mesh
+    (pad floor raised to 16 × devices so every shard keeps a lane-filling
+    row count)."""
     n = len(messages)
     if n == 0:
         return np.zeros(0, dtype=bool)
-    pad = 16
+    n_dev = mesh_devices()
+    floor = 16 * n_dev if n_dev > 1 else 16
+    pad = floor
     while pad < n:
         pad <<= 1
     args = prepare_batch(messages, keys, sigs, pad)
-    mask = np.asarray(_verify_kernel(*(jnp.asarray(a) for a in args)))
+    if n_dev > 1 and pad % n_dev == 0:
+        kernel = _mesh_verify_kernel(n_dev)
+    else:
+        kernel = _verify_kernel
+    mask = np.asarray(kernel(*(jnp.asarray(a) for a in args)))
     return mask[:n]
 
 
@@ -414,10 +471,27 @@ class TpuBackend:
     async def averify_batch_mask(
         self, messages: Sequence[bytes], keys, sigs
     ) -> List[bool]:
+        mask, _ = await self.averify_batch_mask_timed(messages, keys, sigs)
+        return mask
+
+    async def averify_batch_mask_timed(
+        self, messages: Sequence[bytes], keys, sigs
+    ) -> Tuple[List[bool], float]:
+        """(mask, compute_seconds): compute time is measured ON the
+        dispatch thread around host prep + device round trip — the wall
+        the caller observes additionally includes executor queueing and
+        the event-loop wakeup, which is pipelining headroom, not crypto
+        cost (the `crypto.verify.device_seconds` split)."""
         import asyncio
+        import time
+
+        def timed() -> Tuple[List[bool], float]:
+            t0 = time.perf_counter()
+            mask = self.verify_batch_mask(messages, keys, sigs)
+            return mask, time.perf_counter() - t0
 
         return await asyncio.get_running_loop().run_in_executor(
-            self._executor, self.verify_batch_mask, messages, keys, sigs
+            self._executor, timed
         )
 
     def warmup(

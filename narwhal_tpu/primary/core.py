@@ -31,6 +31,21 @@ header→vote→cert round-trip is pipelined here:
 - **Cached address lists**: the committee is static per run, so broadcast
   address lists and the per-author primary address map are computed once
   at init instead of per header/vote/certificate.
+
+Verify-batch window (ISSUE r19, ROADMAP item 1).  With
+``NARWHAL_VERIFY_BATCH_WINDOW_MS > 0`` the peer-message arm of the main
+loop stops verifying inline: drained bursts are forwarded to a
+pipelined ``_verify_loop`` task that coalesces cross-message-type
+signature claims (headers, votes, certificates) from several drains —
+up to ``NARWHAL_VERIFY_BATCH_MAX`` messages or the window, whichever
+closes first — into ONE backend dispatch, then replays in arrival
+order.  The device round trip runs off the event loop (the backend's
+dispatch thread), and run() keeps servicing the proposer/waiter sources
+and draining the network throughout, so consecutive rounds pipeline
+behind the verify instead of stalling — and the arrivals during a
+dispatch deepen the next batch.  The window is the knob that turns the
+r12 mean burst of 3.6 claims into device-sized batches for the
+``jax``/``tpu`` backend (crypto/backend.py).
 """
 
 from __future__ import annotations
@@ -46,7 +61,7 @@ from ..crypto import Digest, PublicKey, SignatureService
 from ..messages import Round
 from ..network import ReliableSender
 from ..store import Store
-from ..utils.env import env_flag
+from ..utils.env import env_flag, env_float, env_int
 from ..utils.serde import Writer
 from .aggregators import CertificatesAggregator, VotesAggregator
 from .errors import (
@@ -96,6 +111,8 @@ class Core:
         tx_proposer: Optional[asyncio.Queue] = None,
         parents_cb: Optional[Callable[[List[Digest], Round], None]] = None,
         fast_path: Optional[bool] = None,
+        verify_window_ms: Optional[float] = None,
+        verify_batch_max: Optional[int] = None,
     ) -> None:
         self.name = name
         self.committee = committee
@@ -125,6 +142,27 @@ class Core:
         if fast_path is None:
             fast_path = env_flag("NARWHAL_VOTE_FAST_PATH")
         self.fast_path = fast_path
+        # Verify-batch accumulation window (ROADMAP item 1): >0 routes
+        # drained peer messages through a pipelined verify task that
+        # coalesces claims from MULTIPLE bursts (headers, votes, certs
+        # alike) arriving within the window into one backend dispatch —
+        # the knob that turns the r12 mean batch of 3.6 into device-
+        # sized batches.  0 (default) keeps the pre-r19 inline behavior:
+        # one averify per drained burst, replay before the next drain.
+        if verify_window_ms is None:
+            verify_window_ms = env_float("NARWHAL_VERIFY_BATCH_WINDOW_MS")
+        self.verify_window_s = max(0.0, float(verify_window_ms) / 1000.0)
+        if verify_batch_max is None:
+            verify_batch_max = env_int("NARWHAL_VERIFY_BATCH_MAX")
+        self.verify_batch_max = max(1, int(verify_batch_max))
+        # Bounded hand-off into the verify pipeline: run() blocks on put
+        # when the pipeline is behind, so rx_primaries (and through it
+        # the network receiver) keeps its backpressure.
+        self._verify_q: Optional[asyncio.Queue] = (
+            asyncio.Queue(maxsize=max(256, 2 * self.verify_batch_max))
+            if self.verify_window_s > 0
+            else None
+        )
 
         self.gc_round: Round = 0
         self.last_voted: Dict[Round, Set[PublicKey]] = {}
@@ -303,9 +341,11 @@ class Core:
             self.voted_ids.setdefault(header.round, {})[header.author] = (
                 header.id
             )
+            # lint: allow-interleave(the vote decision and its witnesses (last_voted add, voted_ids record) are complete in the sync block ABOVE this first yield — a second root replaying the same header while Vote.new awaits takes the else-branch and cannot double-vote; the callee chain's later writes only ever ADD other (round, author) entries)
             vote = await Vote.new(header, self.name, self.signature_service)
             self._m_votes_out.inc()
             log.debug("Created %r", vote)
+            # lint: allow-interleave(equivocation_ids mutates only in the sync else-branch below (setdefault+add before any yield); a cross-root suspension here can at most interleave ANOTHER author's counting, and each distinct twin still counts exactly once)
             await self._dispatch_vote(vote, header)
         else:
             prev_id = self.voted_ids.get(header.round, {}).get(header.author)
@@ -336,6 +376,7 @@ class Core:
         seam so the Byzantine wrapper can withhold votes for targeted
         authors without re-implementing header processing."""
         if vote.origin == self.name:
+            # lint: allow-interleave(_pending_votes/cancel_handlers are append-only lists consumed by the subset-safe _flush_pending / the monotonic GC sweep — a cross-root append or early flush while this own-vote processing is suspended releases staged votes EARLIER behind their already-buffered store records, never out of persist order)
             await self.process_vote(vote)
         elif self.fast_path:
             self._pending_votes.append(
@@ -425,6 +466,7 @@ class Core:
         if certificate.header.id not in self.processing.get(
             certificate.header.round, ()
         ):
+            # lint: allow-interleave(the verify pipeline adds a second root (run + _verify_loop) that can replay this certificate concurrently from the waiter loopback — safely: CertificatesAggregator.append dedupes by origin (a double replay appends nothing), VotesAggregator raises AuthorityReuse into the DagError handler, `processing`/`last_voted`/`voted_ids` mutate in sync blocks before any yield (take-before-yield), and the store writes are idempotent by key)
             await self.process_header(certificate.header)
 
         # All ancestors must be delivered before consensus sees this.
@@ -521,9 +563,11 @@ class Core:
                 kind = item[0]
                 if kind == "header":
                     self.sanitize_header(item[1], sig_ok)
+                    # lint: allow-interleave(window mode runs _handle from two roots — run() for waiter/proposer sources, _verify_loop for peer messages — over the per-round maps and aggregators: every decision+record pair (vote-once via last_voted/voted_ids, equivocation counting, aggregator append) happens in one sync block BEFORE any yield, the aggregators dedupe by authority, and sanitize_* re-checks round state at replay time, so a cross-root suspension can reorder processing but never tear an invariant)
                     await self.process_header(item[1])
                 elif kind == "vote":
                     if sig_ok is not False:  # exclude known-forged votes
+                        # lint: allow-interleave(same two-root discipline as above: _note_peer_vote completes its read-check-count sync before process_vote's first yield, and own_header_ids is only ever written by process_own_header in a sync prefix — a concurrent own-header replacement changes FUTURE counting, never the completed one)
                         self._note_peer_vote(item[1])
                     self.sanitize_vote(item[1], sig_ok)
                     await self.process_vote(item[1])
@@ -633,11 +677,13 @@ class Core:
             # suppress peer_vote_silence).  The verify cost is bounded by
             # the same argument as current-round votes: one signature per
             # message, no amplification.
+            # lint: allow-interleave(current_header/gc_round may advance in the other root while this burst later awaits the backend — safely: both are monotone, so a pre-filter decision taken against an older value is only ever MORE permissive than replay-time sanitize_*, which re-checks the live state and raises TooOld itself; a filter that wrongly marks an item stale cannot happen because rounds never move backward)
             stale = (
                 kind in ("header", "certificate")
                 and item[1].round < self.gc_round
             ) or (
                 kind == "vote"
+                # lint: allow-interleave(same monotone-round argument as the pragma above: a stale verdict taken against an older current_header stays valid because rounds never move backward, and replay-time sanitize_vote re-checks the live header)
                 and item[1].round + 1 < self.current_header.round
             )
             # Re-delivery of an already-verified header/certificate skips
@@ -664,6 +710,7 @@ class Core:
                     h.update(bytes(vn))
                     h.update(bytes(vs))
                 dedup_key = h.digest()
+            # lint: allow-interleave(_handle_primaries_burst is single-flight by mode exclusivity: with the window off _verify_loop is never spawned and only run() calls it; with the window on run() forwards peer messages instead of handling them, so only _verify_loop calls it — the cache read→await→insert window is therefore never concurrent with another burst's insert)
             seen = dedup_key is not None and dedup_key in self._verified_recent
             if seen:
                 self._m_verify_cache_hits.inc()
@@ -704,6 +751,63 @@ class Core:
                     )
             await self._handle("primaries", item, sig_ok)
 
+    async def _verify_loop(self) -> None:
+        """Pipelined verify stage (active when the batch window is on):
+        collect peer messages forwarded by run() until the window
+        closes or the batch cap is hit, then one backend dispatch +
+        in-order replay.  While a dispatch's device round trip is in
+        flight (off the event loop), run() keeps draining the next
+        bursts into the queue — so round N+1's network/proposer work
+        pipelines behind round N's verify instead of stalling, and the
+        backlog naturally deepens the next batch."""
+        queue = self._verify_q
+        loop = asyncio.get_running_loop()
+        while True:
+            items = [await queue.get()]
+            deadline = loop.time() + self.verify_window_s
+            while len(items) < self.verify_batch_max:
+                try:
+                    items.append(queue.get_nowait())
+                    continue
+                except asyncio.QueueEmpty:
+                    pass
+                remaining = deadline - loop.time()
+                if remaining <= 0:
+                    break
+                try:
+                    items.append(
+                        await asyncio.wait_for(queue.get(), remaining)
+                    )
+                except asyncio.TimeoutError:
+                    break
+            # lint: allow-interleave(run() may flush/sweep after a waiter/proposer burst while this replay is suspended — safely: _flush_pending is subset-safe (flush_deferred appends EVERY buffered store record before releasing any staged vote, so persist-before-vote holds for an early flush of a partial burst) and _gc_sweep is monotonic-guarded (gc_round only advances; a concurrent sweep makes this one a no-op))
+            await self._handle_primaries_burst(items)
+            # Same per-burst epilogue as run(): one coalesced log flush
+            # releasing the staged votes, then the per-round-map sweep.
+            self._flush_pending()
+            self._gc_sweep()
+
+    async def _forward_to_verify(self, items, verify_task) -> None:
+        """Forward a drained burst into the verify pipeline.  Each
+        blocked put races the verify task: if the pipeline's sole
+        consumer has crashed, a full queue would otherwise block run()
+        forever with the failure never surfaced — here the crash
+        re-raises out of run() instead."""
+        for item in items:
+            if not self._verify_q.full() and not verify_task.done():
+                self._verify_q.put_nowait(item)
+                continue
+            put = asyncio.ensure_future(self._verify_q.put(item))
+            await asyncio.wait(
+                {put, verify_task}, return_when=asyncio.FIRST_COMPLETED
+            )
+            if verify_task.done():
+                put.cancel()
+                await asyncio.gather(put, return_exceptions=True)
+                verify_task.result()  # re-raises the stage's exception
+                raise RuntimeError("core verify loop exited unexpectedly")
+            await put
+
     async def run(self) -> None:
         sources = {
             "primaries": self.rx_primaries,
@@ -716,11 +820,27 @@ class Core:
             name: loop.create_task(q.get(), name=f"core-{name}")
             for name, q in sources.items()
         }
+        verify_task = (
+            loop.create_task(self._verify_loop(), name="core-verify")
+            if self._verify_q is not None
+            else None
+        )
         try:
             while True:
+                # The verify task rides in the wait set so its death
+                # wakes an otherwise-idle run() immediately; its crash
+                # re-raises here instead of wedging the primary.
+                wait_set = set(gets.values())
+                if verify_task is not None:
+                    wait_set.add(verify_task)
                 done, _ = await asyncio.wait(
-                    set(gets.values()), return_when=asyncio.FIRST_COMPLETED
+                    wait_set, return_when=asyncio.FIRST_COMPLETED
                 )
+                if verify_task is not None and verify_task.done():
+                    verify_task.result()  # surface a crashed verify stage
+                    raise RuntimeError(
+                        "core verify loop exited unexpectedly"
+                    )
                 for name, task in list(gets.items()):
                     if task not in done:
                         continue
@@ -737,7 +857,17 @@ class Core:
                         queue.get(), name=f"core-{name}"
                     )
                     if name == "primaries":
-                        await self._handle_primaries_burst(burst)
+                        if self._verify_q is not None:
+                            # Window mode: hand the burst to the verify
+                            # pipeline and return to draining — the
+                            # proposer/waiter sources stay serviced
+                            # while the batch accumulates/verifies.
+                            await self._forward_to_verify(
+                                burst, verify_task
+                            )
+                        else:
+                            # lint: allow-interleave(mode exclusivity: this arm only runs with the window OFF, where _verify_loop was never spawned — the "other root" the static merge sees cannot exist at runtime; the shared epilogue below is additionally subset-safe/monotonic as pragma'd in _verify_loop)
+                            await self._handle_primaries_burst(burst)
                     else:
                         for item in burst:
                             await self._handle(name, item)
@@ -748,3 +878,6 @@ class Core:
         finally:
             for task in gets.values():
                 task.cancel()
+            if verify_task is not None:
+                verify_task.cancel()
+                await asyncio.gather(verify_task, return_exceptions=True)

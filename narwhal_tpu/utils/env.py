@@ -251,6 +251,44 @@ _VARS = [
         "`batch_withholding` fires when a requested-but-unserved batch "
         "ages past this (above the stock 5 s sync retry delay).",
     ),
+    # -- crypto backend (ROADMAP item 1) --------------------------------------
+    EnvVar(
+        "NARWHAL_CRYPTO_BACKEND", "str", "cpu",
+        "Signature-verification backend selected at node boot (equivalent "
+        "of `node run --crypto-backend`): `cpu` (serial OpenSSL / "
+        "pure-Python fallback) or `jax`/`tpu` (the vmapped batched "
+        "verifier in ops/ed25519.py — `jax` runs on whatever platform "
+        "JAX has, incl. jax-cpu for the A/B fallback arm).",
+    ),
+    EnvVar(
+        "NARWHAL_CRYPTO_BACKEND_STRICT", "flag", True,
+        "`1` (default): a requested jax/tpu backend that fails to import "
+        "raises at boot with the import error. `0`: log the error and "
+        "fall back to the cpu backend — an explicit choice, never a "
+        "silent downgrade mid-burst.",
+    ),
+    EnvVar(
+        "NARWHAL_VERIFY_BATCH_WINDOW_MS", "float", 0.0,
+        "Core verify-batch accumulation window: >0 coalesces signature "
+        "claims from multiple drained bursts (headers, votes, certs) "
+        "arriving within this many ms into ONE backend dispatch, run in "
+        "a pipelined verify task so proposer/waiter work keeps flowing "
+        "during the device round trip. 0 (default) = verify each "
+        "drained burst inline (the pre-r19 behavior).",
+    ),
+    EnvVar(
+        "NARWHAL_VERIFY_BATCH_MAX", "int", 256,
+        "Max messages one coalesced verify dispatch may cover when the "
+        "batch window is enabled (bounds device batch shape and the "
+        "latency added ahead of the first message's replay).",
+    ),
+    EnvVar(
+        "NARWHAL_VERIFY_MESH", "flag", False,
+        "EXPERIMENTAL: shard the batched verify across every visible "
+        "JAX device (jax.sharding.Mesh + shard_map over the batch axis) "
+        "so crypto throughput scales with chips; single-device hosts "
+        "fall back to the plain vmapped kernel.",
+    ),
     # -- device plane ---------------------------------------------------------
     EnvVar(
         "NARWHAL_FIELD_DTYPE", "str", "int32",
