@@ -12,7 +12,8 @@ PYTHON ?= python
 
 .PHONY: check native lint lint-invariants test test-ci metrics-smoke \
 	trace-smoke fault-smoke fault-fuzz-smoke trajectory race-explore \
-	sim-smoke wire-ab-smoke crypto-ab-smoke sanitize bench clean
+	sim-smoke wire-ab-smoke crypto-ab-smoke commit-rule-smoke sanitize \
+	bench clean
 
 check: native lint test
 
@@ -163,6 +164,32 @@ crypto-ab-smoke:
 		--min-batch-mean 0 \
 		--artifact .ci-artifacts/crypto-ab.json
 
+# Commit-rule smoke (ISSUE 15): the lowdepth rule's full validation
+# ladder in CI-affordable sizes — (a) the equivalence + flag-plumbing
+# suite (live LowDepthTusk byte-identical to its frozen oracle, classic
+# byte-identical to GoldenTusk, cross-rule checkpoint refusal, audit
+# rule markers); (b) a race-explore run with --commit-rule lowdepth:
+# 16 seeded schedules byte-identical to the NEW oracle + the socketed
+# committee replay verdicts + the planted race caught under the
+# lowdepth oracle; (c) a sim flag-flip mini-sweep (--commit-rule both):
+# every fuzzed point, control, mutation and acceptance arm under EACH
+# rule, three verdicts per arm, per-arm virtual-time cert→commit means
+# in the artifact.  The full-size flag-flip sweep (200 points) is the
+# release gate run manually; this keeps every arm of it exercised per
+# push.
+commit-rule-smoke:
+	mkdir -p .ci-artifacts
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest \
+		tests/test_lowdepth_equivalence.py -x -q
+	JAX_PLATFORMS=cpu $(PYTHON) benchmark/race_explore.py \
+		--seeds 16 --committee-seeds 2 --commit-rule lowdepth \
+		--workdir .race_explore_lowdepth \
+		--artifact .ci-artifacts/race-explore-lowdepth.json
+	JAX_PLATFORMS=cpu $(PYTHON) benchmark/sim_bench.py \
+		--points 20 --commit-rule both --mutation-seeds 8 \
+		--workdir .sim_commit_rule \
+		--artifact .ci-artifacts/sim-commit-rule-flip.json --quiet
+
 # Asyncio sanitizer tier (ISSUE 10): the fast concurrency-sensitive
 # tier-1 subset under `python -X dev` — asyncio debug mode with the
 # slow-callback threshold aligned to the PR 9 watchdog default
@@ -191,4 +218,5 @@ bench: native
 clean:
 	$(MAKE) -C native clean
 	rm -rf .bench .bench_remote .bench_wire_ab .bench_crypto_ab \
+		.bench_commit_rule_ab .race_explore_lowdepth .sim_commit_rule \
 		.sim_crypto_ab .sim_wire_capture .pytest_cache .ci-artifacts

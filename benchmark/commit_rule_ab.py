@@ -1,0 +1,221 @@
+"""Paired interleaved commit-rule A/B: classic Tusk vs the lowdepth rule
+(ROADMAP item 2, the r10/r19 A/B methodology).
+
+Arms differ ONLY in ``NARWHAL_COMMIT_RULE`` — same committee shape, same
+rate, same wire/crypto planes:
+
+- **classic** — Tusk: the round-L leader commits at depth 3 (a
+  round-(L+3) certificate triggers, f+1 round-(L+1) support).
+- **lowdepth** — the Mysticeti-style rule: the leader commits the
+  moment 2f+1 round-(L+1) certificates cite it (depth 1 on the leader,
+  ~2 averaged over the flattened window), judged against its own frozen
+  oracle everywhere else in the tree.
+
+Arms are interleaved (classic, lowdepth, classic, ...) so slow host
+drift hits both equally.  The target series is the ``cert_to_commit``
+stage leg from the bench JSON (the PR 4 sub-stage attribution measured
+it 97-98% protocol cadence — commit depth × round period — which is
+exactly what a lower commit depth cuts).  Gates:
+
+- zero run errors on BOTH arms;
+- lowdepth median committed TPS within ``--tps-tolerance`` of classic
+  (the latency cut must come at EQUAL throughput);
+- classic/lowdepth median ``cert_to_commit`` ratio ≥ ``--min-speedup``
+  (default 1.6, the "~2×" claim with room for the non-leader tail) —
+  on a drifting shared-core host record WHY with ``--verdict-note``
+  (the r06/r19 honest-verdict precedent) instead of deleting the gate.
+
+Artifact keys are ``classic_runs``/``lowdepth_runs`` — deliberately NOT
+``runs`` so benchmark/trajectory.py does not read a fixed-rate A/B as a
+saturation-series point.
+
+    python benchmark/commit_rule_ab.py --pairs 3 --duration 15 \
+        --artifact artifacts/commit_rule_ab_r20.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmark.local_bench import run_bench  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# The cert→commit sub-stage legs (PR 4): protocol-cadence wait up to the
+# commit trigger, the walk itself, and delivery — reported per arm so
+# the artifact shows WHERE the cut landed (it must be the trigger wait).
+SUB_LEGS = (
+    "cert_inserted_to_commit_trigger",
+    "commit_trigger_to_walk_done",
+    "walk_done_to_commit",
+)
+
+
+def _one_run(arm: str, idx: int, args) -> dict:
+    result = run_bench(
+        nodes=args.nodes,
+        workers=1,
+        rate=args.rate,
+        tx_size=args.tx_size,
+        duration=args.duration,
+        base_port=args.base_port,
+        workdir=os.path.join(REPO, ".bench_commit_rule_ab"),
+        quiet=True,
+        progress_wait=args.progress_wait,
+        commit_rule=arm,
+    )
+    stages = result.stages_ms or {}
+    return {
+        "arm": arm,
+        "run": idx,
+        "errors": result.errors,
+        "consensus_tps": result.consensus_tps,
+        "consensus_latency_ms": result.consensus_latency_ms,
+        "end_to_end_tps": result.end_to_end_tps,
+        "end_to_end_latency_ms": result.end_to_end_latency_ms,
+        "committed_bytes": result.committed_bytes,
+        "cert_to_commit_ms": stages.get("cert_to_commit"),
+        "seal_to_commit_ms": stages.get("seal_to_commit"),
+        "sub_legs_ms": {leg: stages.get(leg) for leg in SUB_LEGS},
+        "stages_ms": stages,
+    }
+
+
+def _median(vals):
+    vals = [v for v in vals if v is not None]
+    return round(statistics.median(vals), 3) if vals else None
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--pairs", type=int, default=3)
+    ap.add_argument("--nodes", type=int, default=4)
+    ap.add_argument("--rate", type=int, default=3_000)
+    ap.add_argument("--tx-size", type=int, default=512)
+    ap.add_argument("--duration", type=int, default=15)
+    ap.add_argument("--base-port", type=int, default=7600)
+    ap.add_argument("--progress-wait", type=float, default=30.0)
+    ap.add_argument(
+        "--min-speedup", type=float, default=1.6,
+        help="Required classic/lowdepth median cert_to_commit ratio "
+        "(the ~2× claim with room for the non-leader tail)",
+    )
+    ap.add_argument(
+        "--tps-tolerance", type=float, default=0.25,
+        help="Lowdepth median committed TPS may be at most this "
+        "fraction below classic (shared-core noise floor)",
+    )
+    ap.add_argument(
+        "--verdict-note", default=None,
+        help="Free-text honest-verdict note recorded as the artifact's "
+        "`host_verdict` (the r06/r19 convention for gates the host "
+        "cannot meet: say WHY, with the measurements)",
+    )
+    ap.add_argument("--artifact", default="artifacts/commit_rule_ab_r20.json")
+    args = ap.parse_args(argv)
+
+    runs = {"classic": [], "lowdepth": []}
+    for i in range(args.pairs):
+        for arm in ("classic", "lowdepth"):
+            print(f"== commit-rule A/B pair {i + 1}/{args.pairs}: {arm} ==")
+            r = _one_run(arm, i, args)
+            runs[arm].append(r)
+            print(
+                f"   committed TPS {r['consensus_tps']:,.0f}, "
+                f"cert_to_commit {r['cert_to_commit_ms']} ms, "
+                f"consensus latency {r['consensus_latency_ms']} ms"
+            )
+
+    failures = []
+    for r in runs["classic"] + runs["lowdepth"]:
+        if r["errors"]:
+            failures.append(f"{r['arm']} run {r['run']}: {r['errors'][:3]}")
+
+    c2c_classic = _median(
+        [r["cert_to_commit_ms"] for r in runs["classic"]]
+    )
+    c2c_lowdepth = _median(
+        [r["cert_to_commit_ms"] for r in runs["lowdepth"]]
+    )
+    tps_classic = _median([r["consensus_tps"] for r in runs["classic"]])
+    tps_lowdepth = _median([r["consensus_tps"] for r in runs["lowdepth"]])
+    speedup = None
+    if c2c_classic is None or c2c_lowdepth is None:
+        failures.append("cert_to_commit missing from an arm's stage trace")
+    else:
+        speedup = round(c2c_classic / c2c_lowdepth, 3)
+        if speedup < args.min_speedup:
+            failures.append(
+                f"cert_to_commit speedup {speedup}x < required "
+                f"{args.min_speedup}x (classic {c2c_classic} ms, "
+                f"lowdepth {c2c_lowdepth} ms)"
+            )
+    if tps_classic and tps_lowdepth is not None and (
+        tps_lowdepth < tps_classic * (1 - args.tps_tolerance)
+    ):
+        failures.append(
+            f"lowdepth median committed TPS {tps_lowdepth:,.0f} more than "
+            f"{args.tps_tolerance:.0%} below classic {tps_classic:,.0f}"
+        )
+
+    summary = {
+        "cert_to_commit_ms": {
+            "classic": c2c_classic, "lowdepth": c2c_lowdepth,
+        },
+        "speedup": speedup,
+        "consensus_tps": {
+            "classic": tps_classic, "lowdepth": tps_lowdepth,
+        },
+        "consensus_latency_ms": {
+            arm: _median([r["consensus_latency_ms"] for r in arm_runs])
+            for arm, arm_runs in runs.items()
+        },
+        "sub_legs_ms": {
+            arm: {
+                leg: _median([r["sub_legs_ms"].get(leg) for r in arm_runs])
+                for leg in SUB_LEGS
+            }
+            for arm, arm_runs in runs.items()
+        },
+        "gates_failed": failures,
+    }
+
+    artifact = {
+        "what": (
+            "Paired interleaved commit-rule A/B (ISSUE 15): classic Tusk "
+            "vs the lowdepth (Mysticeti-style direct-commit) rule on a "
+            f"{args.nodes}-node local_bench, rate {args.rate}, "
+            f"{args.tx_size} B tx, {args.duration} s windows; arms "
+            "differ only in NARWHAL_COMMIT_RULE."
+        ),
+        "classic_runs": runs["classic"],
+        "lowdepth_runs": runs["lowdepth"],
+        "summary": summary,
+    }
+    if args.verdict_note:
+        artifact["host_verdict"] = args.verdict_note
+    os.makedirs(os.path.dirname(args.artifact) or ".", exist_ok=True)
+    with open(args.artifact, "w") as f:
+        json.dump(artifact, f, indent=1)
+
+    print("== commit-rule A/B summary ==")
+    print(json.dumps(summary, indent=1))
+    if failures:
+        print(f"commit-rule A/B FAILED: {failures}", file=sys.stderr)
+        return 1
+    print(
+        f"commit-rule A/B ok: cert_to_commit {c2c_classic} -> "
+        f"{c2c_lowdepth} ms ({speedup}x) at committed TPS "
+        f"{tps_classic:,.0f} -> {tps_lowdepth:,.0f}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

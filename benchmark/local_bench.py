@@ -205,6 +205,7 @@ def run_bench(
     trace_out: str = None,
     wire_v2: bool = None,
     verify_window_ms: float = None,
+    commit_rule: str = None,
 ):
     """Run one committee + clients on localhost; return the ParseResult.
 
@@ -279,6 +280,12 @@ def run_bench(
         # dispatch within this window; None inherits the environment.
         cpu_env["NARWHAL_VERIFY_BATCH_WINDOW_MS"] = str(verify_window_ms)
         tpu_env["NARWHAL_VERIFY_BATCH_WINDOW_MS"] = str(verify_window_ms)
+    if commit_rule is not None:
+        # Commit-rule A/B arm pin: committee-wide like the wire format
+        # (a mixed-rule committee diverges by design); every child gets
+        # the env knob, and each primary's boot log records the rule.
+        cpu_env["NARWHAL_COMMIT_RULE"] = commit_rule
+        tpu_env["NARWHAL_COMMIT_RULE"] = commit_rule
     procs = []
     primary_logs, worker_logs, client_logs = [], [], []
     metrics_paths = []
@@ -604,6 +611,13 @@ def main():
         "unset inherits the environment (default off)",
     )
     parser.add_argument(
+        "--commit-rule", choices=["classic", "lowdepth"], default=None,
+        help="Consensus commit rule for the whole committee "
+        "(NARWHAL_COMMIT_RULE): classic = Tusk depth-3 commits, "
+        "lowdepth = Mysticeti-style direct commits one round after the "
+        "leader; unset inherits the environment (default classic)",
+    )
+    parser.add_argument(
         "--experimental-consensus-kernel",
         dest="consensus_kernel",
         action="store_true",
@@ -636,6 +650,7 @@ def main():
         loop_watchdog_ms=args.loop_watchdog_ms,
         trace_out=args.trace_out,
         verify_window_ms=args.verify_window_ms,
+        commit_rule=args.commit_rule,
     )
     if result.errors:
         print("ERRORS detected in logs:", file=sys.stderr)

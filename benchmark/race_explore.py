@@ -68,6 +68,9 @@ from narwhal_tpu.config import (  # noqa: E402
 )
 from narwhal_tpu.consensus import Consensus  # noqa: E402
 from narwhal_tpu.consensus.golden import GoldenTusk  # noqa: E402
+from narwhal_tpu.consensus.golden_lowdepth import (  # noqa: E402
+    GoldenLowDepthTusk,
+)
 from narwhal_tpu.consensus.replay import (  # noqa: E402
     cross_node_prefix,
     replay_segments,
@@ -153,8 +156,11 @@ def build_stream(committee: Committee) -> List[Certificate]:
     return stream
 
 
-def golden_sequence(committee: Committee, stream: List[Certificate]) -> List[bytes]:
-    golden = GoldenTusk(committee, GC_DEPTH, fixed_coin=False)
+def golden_sequence(
+    committee: Committee, stream: List[Certificate], rule: str = "classic"
+) -> List[bytes]:
+    oracle_cls = GoldenLowDepthTusk if rule == "lowdepth" else GoldenTusk
+    golden = oracle_cls(committee, GC_DEPTH, fixed_coin=False)
     out: List[bytes] = []
     for cert in stream:
         out.extend(bytes(x.digest()) for x in golden.process_certificate(cert))
@@ -232,6 +238,7 @@ async def _pipeline(
     committee: Committee,
     stream: List[Certificate],
     audit_path: Optional[str],
+    rule: str = "classic",
 ) -> List[bytes]:
     rx: asyncio.Queue = asyncio.Queue()
     # Capacity 1: every commit-burst put genuinely SUSPENDS (a put into a
@@ -243,6 +250,7 @@ async def _pipeline(
         committee, GC_DEPTH,
         rx_primary=rx, tx_primary=tx_primary, tx_output=tx_output,
         audit_path=audit_path,
+        commit_rule=rule,
     )
     loop = asyncio.get_running_loop()
     runner = loop.create_task(cons.run())
@@ -312,19 +320,19 @@ async def _pipeline(
 
 
 def run_pipeline_seed(
-    seed: int, workdir: str, mutated: bool = False
+    seed: int, workdir: str, mutated: bool = False, rule: str = "classic"
 ) -> Dict:
     committee = fixture_committee()
     stream = build_stream(committee)
-    want = golden_sequence(committee, stream)
+    want = golden_sequence(committee, stream, rule)
     audit = os.path.join(
-        workdir, f"pipeline-{'mut-' if mutated else ''}{seed}.audit.bin"
+        workdir, f"pipeline-{rule}-{'mut-' if mutated else ''}{seed}.audit.bin"
     )
     if os.path.exists(audit):
         os.remove(audit)
     cls = RacyConsensus if mutated else Consensus
     (committed, guard_tripped), stats = run_with_seed(
-        lambda: _pipeline(cls, committee, stream, audit),
+        lambda: _pipeline(cls, committee, stream, audit, rule),
         seed,
         timeout=90,  # virtual seconds — deterministic per seed
         virtual_time=True,
@@ -341,6 +349,7 @@ def run_pipeline_seed(
 
     return {
         "seed": seed,
+        "commit_rule": rule,
         "mutated": mutated,
         "schedule": stats,
         "guard_tripped": guard_tripped,
@@ -371,7 +380,7 @@ def _tx(i: int) -> bytes:
     return bytes([1]) + (0xACE000 + i).to_bytes(8, "little") + bytes(91)
 
 
-async def _committee(base_port: int, audit_dir: str) -> Dict:
+async def _committee(base_port: int, audit_dir: str, rule: str) -> Dict:
     # Imported here: node wiring pulls the crypto backend, which the
     # pipeline-only invocations never need.
     from narwhal_tpu.node import spawn_primary_node, spawn_worker_node
@@ -399,6 +408,7 @@ async def _committee(base_port: int, audit_dir: str) -> Dict:
                 kp, committee, params,
                 on_commit=lambda cert, i=i: commits[i].append(cert),
                 audit_path=audit,
+                commit_rule=rule,
             )
         )
         workers.append(await spawn_worker_node(kp, 0, committee, params))
@@ -428,12 +438,14 @@ async def _committee(base_port: int, audit_dir: str) -> Dict:
     return {"segments": segments, "payload_committed_on": landed}
 
 
-def run_committee_seed(seed: int, workdir: str, base_port: int) -> Dict:
-    audit_dir = os.path.join(workdir, f"committee-{seed}")
+def run_committee_seed(
+    seed: int, workdir: str, base_port: int, rule: str = "classic"
+) -> Dict:
+    audit_dir = os.path.join(workdir, f"committee-{rule}-{seed}")
     os.makedirs(audit_dir, exist_ok=True)
     committee = fixture_committee()  # replay needs only keys/stakes
     result, stats = run_with_seed(
-        lambda: _committee(base_port, audit_dir), seed, timeout=150
+        lambda: _committee(base_port, audit_dir, rule), seed, timeout=150
     )
     per_node: Dict[str, List[str]] = {}
     verdicts = {}
@@ -454,6 +466,7 @@ def run_committee_seed(seed: int, workdir: str, base_port: int) -> Dict:
     )
     return {
         "seed": seed,
+        "commit_rule": rule,
         "base_port": base_port,
         "schedule": stats,
         "payload_committed_on": result["payload_committed_on"],
@@ -472,6 +485,13 @@ def main(argv=None) -> int:
     ap.add_argument("--seed-base", type=int, default=1000)
     ap.add_argument("--committee-seeds", type=int, default=4,
                     help="socketed committee-scenario seed count")
+    ap.add_argument(
+        "--commit-rule", choices=["classic", "lowdepth"], default="classic",
+        help="Judge every arm against this commit rule's oracle and run "
+        "the committee/pipeline Consensus under it — the lowdepth rule "
+        "must survive the same ≥16-seed schedule exploration against "
+        "ITS golden walk before it can ship (ROADMAP item 2)",
+    )
     ap.add_argument("--skip-mutation", action="store_true")
     ap.add_argument("--artifact", default=None)
     ap.add_argument("--workdir", default=".race_explore")
@@ -483,11 +503,16 @@ def main(argv=None) -> int:
     os.makedirs(args.workdir, exist_ok=True)
 
     if args.repro is not None:
-        report = run_pipeline_seed(args.repro, args.workdir, args.mutated)
+        report = run_pipeline_seed(
+            args.repro, args.workdir, args.mutated, rule=args.commit_rule
+        )
         print(json.dumps(report, indent=1))
         return 0 if report["ok"] or args.mutated else 1
 
-    artifact: Dict = {"pipeline": [], "committee": [], "mutation": None}
+    artifact: Dict = {
+        "commit_rule": args.commit_rule,
+        "pipeline": [], "committee": [], "mutation": None,
+    }
     failures: List[str] = []
 
     def guarded(fn, seed, *a, **kw) -> Dict:
@@ -517,7 +542,9 @@ def main(argv=None) -> int:
     # Arm 1: pipeline, byte-identical across every seed.
     seeds = [args.seed_base + i for i in range(args.seeds)]
     for seed in seeds:
-        report = guarded(run_pipeline_seed, seed, args.workdir)
+        report = guarded(
+            run_pipeline_seed, seed, args.workdir, rule=args.commit_rule
+        )
         artifact["pipeline"].append(report)
         status = (
             f"CRASHED ({report['crashed']})" if report.get("crashed")
@@ -548,7 +575,9 @@ def main(argv=None) -> int:
     # commit bytes (tick counts vary with wall-clock wait polling and
     # are deliberately excluded).
     if seeds:
-        again = guarded(run_pipeline_seed, seeds[0], args.workdir)
+        again = guarded(
+            run_pipeline_seed, seeds[0], args.workdir, rule=args.commit_rule
+        )
         pin_keys = ("sequence_sha", "commits", "identical_to_golden",
                     "audit_replay_ok")
         artifact["determinism_rerun"] = {
@@ -567,7 +596,10 @@ def main(argv=None) -> int:
     for i in range(args.committee_seeds):
         seed = args.seed_base + 500 + i
         base_port = PORT_BASES[i % len(PORT_BASES)]
-        report = guarded(run_committee_seed, seed, args.workdir, base_port)
+        report = guarded(
+            run_committee_seed, seed, args.workdir, base_port,
+            rule=args.commit_rule,
+        )
         artifact["committee"].append(report)
         if report.get("crashed"):
             print(f"[committee] seed {seed}: CRASHED ({report['crashed']})")
@@ -588,7 +620,8 @@ def main(argv=None) -> int:
         caught_dynamic = []
         for seed in seeds:
             report = guarded(
-                run_pipeline_seed, seed, args.workdir, mutated=True
+                run_pipeline_seed, seed, args.workdir, mutated=True,
+                rule=args.commit_rule,
             )
             caught_dynamic.append(report)
             if not report["ok"] and not report.get("crashed"):
@@ -649,10 +682,17 @@ def _dump_repro(artifact_path: Optional[str], report: Dict) -> None:
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     with open(path, "w", encoding="utf-8") as f:
         json.dump(report, f, indent=1)
-    print(
-        f"  repro: {path} (replay with `python benchmark/race_explore.py "
-        f"--repro {report['seed']}`)"
+    # The printed command must carry the report's rule (and mutation
+    # flag): `--repro` re-derives everything from the seed, so a
+    # lowdepth divergence replayed under the classic default would judge
+    # against the wrong oracle and silently pass.
+    replay = (
+        f"python benchmark/race_explore.py --repro {report['seed']} "
+        f"--commit-rule {report.get('commit_rule', 'classic')}"
     )
+    if report.get("mutated"):
+        replay += " --mutated"
+    print(f"  repro: {path} (replay with `{replay}`)")
 
 
 if __name__ == "__main__":

@@ -16,11 +16,20 @@ in virtual time, and health-rule detection.  Alongside the sweep:
 - **determinism pin** — the first point re-run; its deterministic
   artifact (commit sequences + verdicts + events + schedule, wall-clock
   section excluded) must be byte-identical;
-- **mutation arms** (the PR 8/10 honesty pattern) — a committee whose
-  node 0 runs the planted ``RacyConsensus`` must FAIL a safety verdict
-  under at least one explored schedule, and a fuzzed Byzantine draw run
-  with its expectations STRIPPED must still light up its contract rules
-  (the harness detects what it claims, without being told what to find);
+- **mutation arms** (the PR 8/10 honesty pattern) — per commit-rule
+  arm, a committee whose node 0 runs the planted ``CorruptingConsensus``
+  (deterministic dropped + re-committed certificates, the two bug
+  classes the PR 6 fault suite caught for real) must FAIL a safety
+  verdict on the FIRST schedule, and a fuzzed Byzantine draw run with
+  its expectations STRIPPED must still light up its contract rules (the
+  harness detects what it claims, without being told what to find).
+  The schedule-DEPENDENT ``RacyConsensus`` plant additionally must be
+  caught in at least one arm of the sweep: its corruption needs the
+  commit backlog to outrun the capacity-1 output puts, which classic's
+  deep commit bursts produce under nearly every schedule while
+  lowdepth's prompt shallow bursts do not at sim exploration intensity
+  — ``race_explore.py --commit-rule lowdepth`` (~40× the permutation
+  pressure) is the instrument that manifests and catches it per rule;
 - **acceptance arm** — a 60-virtual-second N=20 committee with a fuzzed
   fault composition; its wall seconds and compression ratio are
   measured and reported (ROADMAP item 6's 100-1000× wall-clock
@@ -65,6 +74,9 @@ def _point_summary(art: dict) -> dict:
         "nodes": art["nodes"],
         "scenario_seed": art["scenario_seed"],
         "run_seed": art["run_seed"],
+        "commit_rule": art.get("commit_rule", "classic"),
+        "cert_to_commit": art.get("cert_to_commit"),
+        "observers": v["detection"].get("observers", {}),
         "ok": art["ok"],
         "safety": v["safety"]["ok"],
         "liveness": v["liveness"]["ok"],
@@ -82,14 +94,20 @@ def _dump_repro(artifact_path: Optional[str], name: str, obj: dict,
     base = artifact_path or os.path.join(".sim_bench", "sim.json")
     path = f"{base}.repro-{name}.json"
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    # The arm is part of the repro: a flag-flip sweep dumps failures
+    # from BOTH rules, and a lowdepth-arm failure replayed under the
+    # classic default would judge against the wrong oracle and not
+    # reproduce.  run_replay prefers this recorded rule.
+    rule = art.get("commit_rule", "classic")
     with open(path, "w") as f:
         json.dump(
             {
                 "spec": obj,
                 "run_seed": run_seed,
+                "commit_rule": rule,
                 "verdicts": art["verdicts"],
                 "replay": "python benchmark/sim_bench.py --replay "
-                f"{path} --run-seed {run_seed}",
+                f"{path} --run-seed {run_seed} --commit-rule {rule}",
             },
             f, indent=1,
         )
@@ -111,7 +129,18 @@ def run_sweep(args) -> int:
     failures: List[str] = []
     points: List[dict] = []
     sizes_seen: set = set()
-    first: Optional[tuple] = None  # (obj, run_seed, blob) for the pin
+    # (obj, run_seed, blob) per arm for the determinism pin.
+    first: Dict[str, tuple] = {}
+    # classic | lowdepth | both — `both` is the commit-rule FLAG-FLIP
+    # sweep (ROADMAP item 2): every fuzzed point runs under each rule,
+    # each arm judged by all three verdicts (safety against the arm's
+    # own frozen oracle via the audit rule marker), and the virtual-time
+    # cert→commit means price the latency claim per arm.
+    arms = (
+        ["classic", "lowdepth"]
+        if args.commit_rule == "both"
+        else [args.commit_rule or "classic"]
+    )
 
     # -- the sweep -------------------------------------------------------------
     specs = []
@@ -143,6 +172,8 @@ def run_sweep(args) -> int:
                 "workers": scenario.workers,
                 "scenario_seed": scenario.seed,
                 "run_seed": run_seed,
+                "commit_rule": kw.get("commit_rule") or "classic",
+                "cert_to_commit": {"count": 0, "mean_virtual_s": None},
                 "ok": False,
                 "crashed": f"{type(exc).__name__}: {exc}",
                 "verdicts": {
@@ -161,135 +192,209 @@ def run_sweep(args) -> int:
             }
 
     for k, (fuzz_seed, obj) in enumerate(specs):
-        scenario = parse_scenario(obj, env={})
-        run_seed = base + 10_000 + k
-        art = guarded(
-            scenario, run_seed,
-            os.path.join(args.workdir, f"pt{k}-{scenario.name}"),
-        )
-        sizes_seen.add(scenario.nodes)
-        summary = _point_summary(art)
-        points.append(summary)
-        if first is None:
-            first = (obj, run_seed, deterministic_blob(art))
-        status = "ok" if art["ok"] else "FAILED"
-        if not args.quiet:
-            # wall_s/compression are None on the virtual-timeout path —
-            # exactly the point whose progress line must not crash
-            # before its repro is dumped below.
-            wall = summary["wall_s"]
-            print(
-                f"[{k + 1}/{len(specs)}] {scenario.name} n={scenario.nodes}"
-                f" run_seed={run_seed}: {status}"
-                f" ({'timeout' if wall is None else f'{wall:.1f}s wall'},"
-                f" {summary['compression']}x)"
+        for arm in arms:
+            scenario = parse_scenario(obj, env={})
+            run_seed = base + 10_000 + k
+            art = guarded(
+                scenario, run_seed,
+                os.path.join(args.workdir, f"pt{k}-{arm}-{scenario.name}"),
+                commit_rule=arm,
             )
-        if not art["ok"]:
-            failures.append(f"point {scenario.name} failed its verdicts")
-            path = _dump_repro(
-                args.artifact, f"{scenario.name}-{run_seed}", obj,
-                run_seed, art,
-            )
-            print(f"  repro: {path}", file=sys.stderr)
+            sizes_seen.add(scenario.nodes)
+            summary = _point_summary(art)
+            points.append(summary)
+            if arm not in first:
+                first[arm] = (obj, run_seed, deterministic_blob(art))
+            status = "ok" if art["ok"] else "FAILED"
+            if not args.quiet:
+                # wall_s/compression are None on the virtual-timeout path
+                # — exactly the point whose progress line must not crash
+                # before its repro is dumped below.
+                wall = summary["wall_s"]
+                c2c = (summary["cert_to_commit"] or {}).get("mean_virtual_s")
+                print(
+                    f"[{k + 1}/{len(specs)}] {scenario.name}"
+                    f" n={scenario.nodes} arm={arm}"
+                    f" run_seed={run_seed}: {status}"
+                    f" ({'timeout' if wall is None else f'{wall:.1f}s wall'},"
+                    f" {summary['compression']}x, c2c {c2c}s)"
+                )
+            if not art["ok"]:
+                failures.append(
+                    f"point {scenario.name} ({arm} arm) failed its verdicts"
+                )
+                path = _dump_repro(
+                    args.artifact, f"{scenario.name}-{arm}-{run_seed}", obj,
+                    run_seed, art,
+                )
+                print(f"  repro: {path}", file=sys.stderr)
 
-    # -- clean controls per size ----------------------------------------------
+    # -- clean controls per size per arm ---------------------------------------
     controls = []
     for n in sorted(sizes_seen):
-        obj = {
-            "name": f"sim_control_n{n}", "nodes": n, "workers": 1,
-            "rate": 600, "tx_size": 512,
-            "duration": 25, "seed": base ^ n,
-        }
-        scenario = parse_scenario(obj, env={})
-        art = guarded(
-            scenario, base + 20_000 + n,
-            os.path.join(args.workdir, f"control-n{n}"),
-        )
-        controls.append(_point_summary(art))
-        if not art["ok"]:
-            failures.append(
-                f"control n={n} failed (fired: "
-                f"{art['verdicts']['detection']['fired']})"
+        for arm in arms:
+            obj = {
+                "name": f"sim_control_n{n}", "nodes": n, "workers": 1,
+                "rate": 600, "tx_size": 512,
+                "duration": 25, "seed": base ^ n,
+            }
+            scenario = parse_scenario(obj, env={})
+            art = guarded(
+                scenario, base + 20_000 + n,
+                os.path.join(args.workdir, f"control-{arm}-n{n}"),
+                commit_rule=arm,
             )
-            _dump_repro(args.artifact, f"control-n{n}", obj,
-                        base + 20_000 + n, art)
-        if not args.quiet:
-            print(f"[control n={n}] {'ok' if art['ok'] else 'FAILED'}")
+            controls.append(_point_summary(art))
+            if not art["ok"]:
+                failures.append(
+                    f"control n={n} ({arm} arm) failed (fired: "
+                    f"{art['verdicts']['detection']['fired']})"
+                )
+                _dump_repro(args.artifact, f"control-{arm}-n{n}", obj,
+                            base + 20_000 + n, art)
+            if not args.quiet:
+                print(
+                    f"[control n={n} {arm}] "
+                    f"{'ok' if art['ok'] else 'FAILED'}"
+                )
 
-    # -- determinism pin -------------------------------------------------------
-    determinism = None
-    if first is not None:
-        obj, run_seed, blob = first
+    # -- determinism pin per arm -----------------------------------------------
+    determinism = []
+    for arm in arms:
+        if arm not in first:
+            continue
+        obj, run_seed, blob = first[arm]
         again = run_sim_scenario(
             parse_scenario(obj, env={}), run_seed,
-            os.path.join(args.workdir, "determinism-rerun"),
+            os.path.join(args.workdir, f"determinism-rerun-{arm}"),
+            commit_rule=arm,
         )
-        determinism = {
+        pin = {
             "name": obj["name"],
+            "commit_rule": arm,
             "run_seed": run_seed,
             "bit_identical": deterministic_blob(again) == blob,
         }
-        if not determinism["bit_identical"]:
+        determinism.append(pin)
+        if not pin["bit_identical"]:
             failures.append(
                 f"determinism pin: two runs of ({obj['name']}, "
-                f"run_seed={run_seed}) produced different artifacts"
+                f"run_seed={run_seed}, {arm} arm) produced different "
+                "artifacts"
             )
         if not args.quiet:
-            print(f"[determinism] bit_identical={determinism['bit_identical']}")
-
-    # -- mutation arms ---------------------------------------------------------
-    mutation = None
-    if not args.skip_mutation:
-        mutation = run_mutation_arms(args, base)
-        if not mutation["racy_caught"]:
-            failures.append(
-                "mutation arm: planted RacyConsensus was never caught by "
-                "a safety verdict"
-            )
-        if not mutation["byzantine_caught"]:
-            failures.append(
-                "mutation arm: fuzzed Byzantine draw with stripped "
-                "expectations fired none of its contract rules"
-            )
-
-    # -- acceptance arm: N=20, 60 virtual seconds ------------------------------
-    acceptance = None
-    if not args.skip_acceptance:
-        obj = generate(base + 31_337, sizes=(20,))
-        obj["name"] = "sim_accept_n20_60s"
-        obj["duration"] = max(60, obj["duration"])
-        scenario = parse_scenario(obj, env={})
-        art = guarded(
-            scenario, base + 31_337,
-            os.path.join(args.workdir, "accept-n20"),
-        )
-        acceptance = _point_summary(art)
-        acceptance["behaviors"] = [
-            b.behaviors for b in scenario.byzantine
-        ]
-        if not art["ok"]:
-            failures.append("acceptance arm (N=20, 60 virtual s) failed "
-                            "its verdicts")
-            _dump_repro(args.artifact, "accept-n20", obj, base + 31_337, art)
-        comp = acceptance["compression"] or 0.0
-        if comp < _MIN_COMPRESSION:
-            failures.append(
-                f"acceptance arm compression {comp}x is below the "
-                f"{_MIN_COMPRESSION}x floor"
-            )
-        if not args.quiet:
-            wall = acceptance["wall_s"]
             print(
-                "[acceptance] N=20 60 virtual s: "
-                + ("timeout" if wall is None else f"{wall:.2f}s wall")
-                + f", {comp}x compression"
+                f"[determinism {arm}] bit_identical={pin['bit_identical']}"
             )
+
+    # -- mutation arms (per commit rule: each arm's oracle must catch a
+    # planted sequence corruption, or a flag-flip sweep's safety gate is
+    # vacuous for that arm.  The schedule-dependent racy plant gates at
+    # sweep level — see the module docstring for why its window shape is
+    # rule-dependent and which harness manifests it per rule) ------------------
+    mutation = []
+    if not args.skip_mutation:
+        for arm in arms:
+            m = run_mutation_arms(args, base, arm)
+            mutation.append(m)
+            if not m["corruption_caught"]:
+                failures.append(
+                    f"mutation arm ({arm}): planted CorruptingConsensus "
+                    "(deterministic dropped + re-committed certificates) "
+                    "was not caught by a safety verdict — this arm's "
+                    "oracle is not judging its own sequences"
+                )
+            if not m["byzantine_caught"]:
+                failures.append(
+                    f"mutation arm ({arm}): fuzzed Byzantine draw with "
+                    "stripped expectations fired none of its contract "
+                    "rules"
+                )
+        if mutation and not any(m["racy_caught"] for m in mutation):
+            failures.append(
+                "mutation arms: planted RacyConsensus was caught under "
+                "NO commit-rule arm — the explored schedules lost the "
+                "await-window race entirely (race_explore.py is the "
+                "dedicated instrument if this regresses)"
+            )
+
+    # -- acceptance arm: N=20, 60 virtual seconds, per commit rule -------------
+    acceptance = []
+    if not args.skip_acceptance:
+        for arm in arms:
+            obj = generate(base + 31_337, sizes=(20,))
+            obj["name"] = "sim_accept_n20_60s"
+            obj["duration"] = max(60, obj["duration"])
+            scenario = parse_scenario(obj, env={})
+            art = guarded(
+                scenario, base + 31_337,
+                os.path.join(args.workdir, f"accept-{arm}-n20"),
+                commit_rule=arm,
+            )
+            acc = _point_summary(art)
+            acc["behaviors"] = [b.behaviors for b in scenario.byzantine]
+            acceptance.append(acc)
+            if not art["ok"]:
+                failures.append(
+                    f"acceptance arm (N=20, 60 virtual s, {arm}) failed "
+                    "its verdicts"
+                )
+                _dump_repro(args.artifact, f"accept-{arm}-n20", obj,
+                            base + 31_337, art)
+            comp = acc["compression"] or 0.0
+            if comp < _MIN_COMPRESSION:
+                failures.append(
+                    f"acceptance arm ({arm}) compression {comp}x is below "
+                    f"the {_MIN_COMPRESSION}x floor"
+                )
+            if not args.quiet:
+                wall = acc["wall_s"]
+                print(
+                    f"[acceptance {arm}] N=20 60 virtual s: "
+                    + ("timeout" if wall is None else f"{wall:.2f}s wall")
+                    + f", {comp}x compression"
+                )
+
+    # -- virtual-time latency pricing ------------------------------------------
+    # Weighted committee-wide mean cert→commit per arm over every sweep
+    # point (weights = per-point commit counts).  Virtual time carries no
+    # host noise, so the ratio IS the protocol-cadence claim.
+    latency = {}
+    for arm in arms:
+        total_s, total_n = 0.0, 0
+        for s in points:
+            if s["commit_rule"] != arm:
+                continue
+            c2c = s.get("cert_to_commit") or {}
+            if c2c.get("mean_virtual_s") is not None:
+                total_s += c2c["mean_virtual_s"] * c2c["count"]
+                total_n += c2c["count"]
+        latency[arm] = {
+            "commits": total_n,
+            "mean_virtual_s": (
+                round(total_s / total_n, 6) if total_n else None
+            ),
+        }
+    if (
+        len(arms) == 2
+        and latency["classic"]["mean_virtual_s"]
+        and latency["lowdepth"]["mean_virtual_s"]
+    ):
+        latency["classic_over_lowdepth"] = round(
+            latency["classic"]["mean_virtual_s"]
+            / latency["lowdepth"]["mean_virtual_s"],
+            3,
+        )
+    if not args.quiet and latency:
+        print(f"[latency] {json.dumps(latency)}")
 
     artifact = {
         "generated_by": "benchmark/sim_bench.py",
         "ok": not failures,
         "failures": failures,
+        "commit_rule_arms": arms,
         "points_explored": len(points),
+        "latency": latency,
         "sizes": sorted(sizes_seen),
         "points": points,
         "controls": controls,
@@ -316,17 +421,73 @@ def run_sweep(args) -> int:
     return 0
 
 
-def run_mutation_arms(args, base: int) -> dict:
+class CorruptingConsensus:
+    """Deterministic commit-sequence corruption: the per-rule safety
+    judge's non-vacuity article.  Wraps the live rule's
+    ``process_certificate`` to DROP the first certificate of the third
+    non-empty commit burst and RE-COMMIT a stale certificate on the
+    fifth — the two corruption classes the golden-replay judge exists
+    for (PR 6's restart left a permanent commit-log hole = a drop; a
+    racy staging list re-delivered a burst = a duplicate).  Both flow
+    through the real audit ('C' records) and delivery path, so the
+    arm's segment MUST diverge from the arm's own frozen oracle on the
+    FIRST schedule — under either commit rule, which is what the
+    schedule-dependent RacyConsensus plant cannot guarantee (see the
+    module docstring).
+
+    Built as a mixin-style factory rather than a subclass of Consensus:
+    the corruption point is the rule output, not the event loop."""
+
+    def __new__(cls, *args, **kwargs):
+        from narwhal_tpu.consensus import Consensus
+
+        self = Consensus(*args, **kwargs)
+        inner = self.tusk.process_certificate
+        state = {"bursts": 0, "stale": None}
+
+        def corrupt(certificate):
+            seq = inner(certificate)
+            if seq:
+                state["bursts"] += 1
+                if state["stale"] is None:
+                    state["stale"] = seq[0]
+                if state["bursts"] == 3:
+                    seq = seq[1:]            # dropped commit
+                elif state["bursts"] == 5:
+                    seq = seq + [state["stale"]]  # re-commit
+            return seq
+
+        self.tusk.process_certificate = corrupt
+        return self
+
+
+def run_mutation_arms(args, base: int, commit_rule: str = "classic") -> dict:
     """The non-vacuity proof: the harness must CATCH what it claims to.
 
-    (a) racy consensus — node 0 runs ``RacyConsensus`` (the PR 10
+    (a) corrupting consensus — node 0 runs ``CorruptingConsensus``
+    (deterministic dropped + re-committed certificates) and the FIRST
+    schedule must fail a safety verdict, per arm — the proof that THIS
+    arm's oracle judges its own sequences;
+    (b) racy consensus — node 0 runs ``RacyConsensus`` (the PR 10
     found-race shape, imported from race_explore so the two harnesses
-    can never drift apart) and at least one explored schedule must fail
-    a safety verdict;
-    (b) planted Byzantine — a fuzzed adversarial draw runs with its
+    can never drift apart); whether an explored schedule manifests it
+    is recorded per arm, gated at sweep level (module docstring);
+    (c) planted Byzantine — a fuzzed adversarial draw runs with its
     ``expect.rules`` stripped, and the detection plane must fire its
     contract rules anyway."""
     from benchmark.race_explore import RacyConsensus
+
+    corrupt_obj = {
+        "name": "sim_mut_corrupt", "nodes": 4, "workers": 1, "rate": 600,
+        "tx_size": 256, "duration": 15, "seed": base ^ 0xC0DE,
+    }
+    corrupt_art = run_sim_scenario(
+        parse_scenario(corrupt_obj, env={}), base + 29_000,
+        os.path.join(args.workdir, f"mut-corrupt-{commit_rule}"),
+        consensus_cls_by_node={0: CorruptingConsensus},
+        commit_rule=commit_rule,
+    )
+    corruption_caught = not corrupt_art["verdicts"]["safety"]["ok"]
 
     racy_runs = []
     racy_hit = None
@@ -338,8 +499,9 @@ def run_mutation_arms(args, base: int) -> dict:
         run_seed = base + 30_000 + attempt
         art = run_sim_scenario(
             parse_scenario(clean_obj, env={}), run_seed,
-            os.path.join(args.workdir, f"mut-racy-{attempt}"),
+            os.path.join(args.workdir, f"mut-racy-{commit_rule}-{attempt}"),
             consensus_cls_by_node={0: RacyConsensus},
+            commit_rule=commit_rule,
         )
         racy_runs.append({
             "run_seed": run_seed,
@@ -360,19 +522,31 @@ def run_mutation_arms(args, base: int) -> dict:
     stripped = dict(byz_obj, name="sim_mut_byz", expect={"rules": []})
     art = run_sim_scenario(
         parse_scenario(stripped, env={}), base + 41_000,
-        os.path.join(args.workdir, "mut-byz"),
+        os.path.join(args.workdir, f"mut-byz-{commit_rule}"),
+        commit_rule=commit_rule,
     )
     fired = art["verdicts"]["detection"]["fired"]
     byz_caught = bool(set(expected) & set(fired))
 
     if not args.quiet:
         print(
-            f"[mutation] racy: "
+            f"[mutation {commit_rule}] corruption: "
+            + ("caught" if corruption_caught else "NOT caught")
+            + "; racy: "
             + (f"caught at run_seed {racy_hit}" if racy_hit is not None
                else f"NOT caught in {len(racy_runs)} schedules")
             + f"; byzantine (stripped {expected}): fired {fired}"
         )
     return {
+        "commit_rule": commit_rule,
+        "corruption_caught": corruption_caught,
+        "corruption_violations": [
+            v
+            for _, nv in sorted(
+                corrupt_art["verdicts"]["safety"]["nodes"].items()
+            )
+            for v in nv.get("violations", [])
+        ][:4],
         "racy_runs": racy_runs,
         "racy_caught": racy_hit is not None,
         "racy_seed": racy_hit,
@@ -388,14 +562,21 @@ def run_replay(args) -> int:
     with open(args.replay) as f:
         obj = json.load(f)
     run_seed = args.run_seed
+    # Explicit --commit-rule wins; else the rule RECORDED in the repro
+    # (the arm that failed); else the resolver default.  `both` is a
+    # sweep concept, not a single replay's.
+    rule = None if args.commit_rule == "both" else args.commit_rule
     if "spec" in obj and isinstance(obj["spec"], dict):
         if run_seed is None and "run_seed" in obj:
             run_seed = int(obj["run_seed"])
+        if rule is None and obj.get("commit_rule") in ("classic", "lowdepth"):
+            rule = obj["commit_rule"]
         obj = obj["spec"]
     scenario = parse_scenario(obj, env={})
     art = run_sim_scenario(
         scenario, run_seed if run_seed is not None else 0,
         os.path.join(args.workdir, f"replay-{scenario.name}"),
+        commit_rule=rule,
     )
     print(json.dumps(_point_summary(art), indent=1))
     for k, v in art["verdicts"].items():
@@ -414,6 +595,15 @@ def main(argv=None) -> int:
     ap.add_argument("--workdir", default=".sim_bench")
     ap.add_argument("--mutation-seeds", type=int, default=12,
                     help="max schedules to try for the racy arm")
+    ap.add_argument(
+        "--commit-rule", choices=["classic", "lowdepth", "both"],
+        default=None,
+        help="Commit rule for every committee in the sweep; `both` is "
+        "the flag-flip sweep — every fuzzed point, control, mutation and "
+        "acceptance arm runs under EACH rule, safety judged against the "
+        "arm's own frozen oracle, with per-arm virtual-time cert→commit "
+        "means pricing the latency claim (ROADMAP item 2)",
+    )
     ap.add_argument("--skip-mutation", action="store_true")
     ap.add_argument("--skip-acceptance", action="store_true")
     ap.add_argument("--replay", default=None,

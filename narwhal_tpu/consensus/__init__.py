@@ -1,3 +1,19 @@
-from .tusk import Consensus, Tusk, State
+from .tusk import (
+    COMMIT_RULES,
+    CheckpointRuleMismatch,
+    Consensus,
+    LowDepthTusk,
+    State,
+    Tusk,
+    resolve_commit_rule,
+)
 
-__all__ = ["Consensus", "Tusk", "State"]
+__all__ = [
+    "COMMIT_RULES",
+    "CheckpointRuleMismatch",
+    "Consensus",
+    "LowDepthTusk",
+    "State",
+    "Tusk",
+    "resolve_commit_rule",
+]

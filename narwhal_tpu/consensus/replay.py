@@ -110,6 +110,21 @@ _LEN = struct.Struct("<I")
 TAG_RESTORE = b"R"
 TAG_INSERT = b"I"
 TAG_COMMIT = b"C"
+# Commit-rule marker ('classic' | 'lowdepth'), written immediately after
+# the restore marker.  Segments recorded before the marker existed have
+# none and replay under the classic oracle — exactly what recorded them.
+TAG_RULE = b"M"
+
+_RULE_ORACLES = {"classic": GoldenTusk}
+
+
+def _oracle_for(rule: str):
+    if rule == "lowdepth":
+        # Deferred: the classic-only paths never import the second oracle.
+        from .golden_lowdepth import GoldenLowDepthTusk
+
+        return GoldenLowDepthTusk
+    return _RULE_ORACLES[rule]
 
 
 class AuditWriter:
@@ -143,6 +158,9 @@ class AuditWriter:
     def restore_marker(self, blob: bytes) -> None:
         self._record(TAG_RESTORE, blob)
 
+    def rule_marker(self, rule: str) -> None:
+        self._record(TAG_RULE, rule.encode("ascii"))
+
     def insert(self, certificate: Certificate) -> None:
         self._record(TAG_INSERT, certificate.serialize())
 
@@ -168,7 +186,7 @@ def read_audit(path: str) -> List[Tuple[bytes, bytes]]:
     pos, n = 0, len(data)
     while pos + 1 + _LEN.size <= n:
         tag = data[pos : pos + 1]
-        if tag not in (TAG_RESTORE, TAG_INSERT, TAG_COMMIT):
+        if tag not in (TAG_RESTORE, TAG_INSERT, TAG_COMMIT, TAG_RULE):
             break  # corrupt record boundary; treat like a tear
         (length,) = _LEN.unpack_from(data, pos + 1)
         end = pos + 1 + _LEN.size + length
@@ -205,21 +223,58 @@ def replay_segments(
     slot_by_digest: Dict[bytes, Tuple[Round, bytes]] = {}
     slots_committed: Dict[Tuple[Round, bytes], bytes] = {}
     golden_total = 0
+    segment_rules: List[str] = []
 
     for seg_i, path in enumerate(segment_paths):
         records = read_audit(path)
+        # Every path through this loop body appends exactly one entry to
+        # segment_rules, rejected segments included — the verdict's
+        # `rules` list must stay index-aligned with segment order or a
+        # consumer joining rules[i] to segment i reads the wrong rule.
         if not records:
             violations.append(f"segment {seg_i}: empty or unreadable")
+            segment_rules.append("unreadable")
             continue
         if records[0][0] != TAG_RESTORE:
             violations.append(
                 f"segment {seg_i}: does not start with a restore marker"
             )
+            segment_rules.append("unreadable")
             continue
-        golden = GoldenTusk(committee, gc_depth, fixed_coin=fixed_coin)
+        # The rule marker (if present) is the record after the restore
+        # marker: each segment replays under the oracle of the rule that
+        # RECORDED it — a flag-flip sweep's two arms, or a node restarted
+        # under the other rule (new incarnation = new segment), judge
+        # themselves without harness plumbing.  Marker-less segments
+        # predate the marker and replay classic.
+        rule = "classic"
+        body = records[1:]
+        if body and body[0][0] == TAG_RULE:
+            raw = body[0][1].decode("ascii", "replace")
+            if raw not in ("classic", "lowdepth"):
+                violations.append(
+                    f"segment {seg_i}: unknown commit-rule marker {raw!r}"
+                )
+                segment_rules.append(raw)
+                continue
+            rule = raw
+            body = body[1:]
+        segment_rules.append(rule)
+        golden = _oracle_for(rule)(committee, gc_depth, fixed_coin=fixed_coin)
         blob = records[0][1]
         if blob:
-            golden.state.restore(blob)
+            try:
+                golden.state.restore(blob)
+            except Exception as exc:
+                # Including the cross-rule magic mismatch: a segment whose
+                # restore blob was written by the OTHER rule's state is a
+                # recording inconsistency the verdict must surface, not
+                # crash on.
+                violations.append(
+                    f"segment {seg_i}: restore blob does not parse under "
+                    f"the {rule!r} oracle ({exc!r})"
+                )
+                continue
         inserts: Dict[bytes, Certificate] = {}
         golden_commits: List[bytes] = []
         golden_committed_set: set = set()
@@ -234,10 +289,15 @@ def replay_segments(
         # (found by the sim sweep's deeper DAGs; the walk itself was
         # correct).
         frontier: Dict[bytes, Round] = dict(golden.state.last_committed)
-        for tag, payload in records[1:]:
+        for tag, payload in body:
             if tag == TAG_RESTORE:
                 violations.append(
                     f"segment {seg_i}: restore marker mid-segment"
+                )
+                break
+            if tag == TAG_RULE:
+                violations.append(
+                    f"segment {seg_i}: commit-rule marker mid-segment"
                 )
                 break
             if tag == TAG_COMMIT:
@@ -336,6 +396,7 @@ def replay_segments(
         "ok": not violations,
         "violations": violations,
         "segments": len(segment_paths),
+        "rules": segment_rules,
         "recorded_commits": len(recorded_all),
         "golden_commits": golden_total,
         "unverifiable_parents": unverifiable_parents,

@@ -79,6 +79,7 @@ class ByzantinePlan:
                 "(both decide what the worker Helper serves)"
             )
         self.behaviors = set(behaviors)
+        self.seed = seed
         self.rng = random.Random(seed)
         # None = withhold from every other author.
         self.withhold_targets = withhold_targets
@@ -120,16 +121,38 @@ class ByzantinePlan:
         with open(path) as f:
             return cls.from_json(json.load(f))
 
-    def split_peers(
-        self, addresses: Sequence[str], keep: int
+    def favored_split(
+        self, addr_by_name: Dict, keep: int
     ) -> Tuple[List[str], List[str]]:
-        """Seeded shuffle of the peer list into (real-header share, twin
-        share).  ``keep`` peers get the real header — sized by the caller
-        to quorum−1 so our own vote still completes the certificate."""
-        addrs = list(addresses)
-        self.rng.shuffle(addrs)
-        keep = max(0, min(keep, len(addrs)))
-        return addrs[:keep], addrs[keep:]
+        """The adversary's ONE coordinated peer split, keyed by authority
+        so every plane of this node favors the SAME validators: the
+        Core's real-header share and the worker's batch under-share both
+        take the first ``keep`` names of one seed-derived permutation.
+
+        Coordination is the point (and what a real adversary would do):
+        when the splits were drawn independently per plane and per round,
+        an equivocate+withhold composition starved the adversary's OWN
+        vote quorum — the real-header share needed every member to hold
+        the under-shared batch, which at N≥10 almost never happened, so
+        the certificate never formed, never crossed the split to the
+        twin-voters, and the committee could not prove the equivocation
+        it was expected to detect (sim sweep points 7023/7024/7034/7035).
+        Aligned splits keep the attack COHERENT: the favored quorum can
+        vote, the certificate forms, and the starved side both misses
+        batches (the withholding evidence) and holds the twin (the
+        equivocation evidence).
+
+        Deterministic from the plan seed and the roster alone — two
+        independently-loaded plan instances (one per role process) with
+        the same seed produce the same split, and nothing here consumes
+        the shared sequential ``self.rng`` stream."""
+        names = sorted(addr_by_name)
+        random.Random(f"narwhal-favored-peers:{self.seed}").shuffle(names)
+        keep = max(0, min(keep, len(names)))
+        return (
+            [addr_by_name[n] for n in names[:keep]],
+            [addr_by_name[n] for n in names[keep:]],
+        )
 
 
 def _require_unit_stake(committee, behavior: str = "equivocate") -> None:
@@ -250,8 +273,12 @@ class ByzantineCore(Core):
             return self.network.broadcast(
                 self.others_addresses, message, msg_type="header"
             )
-        real_share, twin_share = plan.split_peers(
-            self.others_addresses,
+        real_share, twin_share = plan.favored_split(
+            {
+                n: self.committee.primary(n).primary_to_primary
+                for n in self.committee.authorities
+                if n != self.name
+            },
             self.committee.quorum_threshold() - 1,
         )
         handlers = self.network.broadcast(
@@ -303,11 +330,38 @@ class ByzantineCore(Core):
             if replay_task is not None:
                 replay_task.cancel()
 
+    def _seed_stale_from_store(self) -> None:
+        """A restarted replay attacker replays its OLD certificates, not
+        its post-restart ones: without this, a crash/restart composition
+        re-anchored ``_stale_certs`` at the restart round and the GC
+        horizon could not pass them within any affordable scenario
+        window (sim sweep point 7017 at N=20) — a replay adversary that
+        forgets what it persisted is not a believable adversary.  Scans
+        the retained store once at replay start for our earliest own
+        vote-carrying certificates."""
+        from ..primary.messages import Certificate
+
+        mine = []
+        for value in self.store.values():
+            if len(value) < 140:
+                continue
+            try:
+                cert = Certificate.deserialize(value)
+            except Exception:
+                continue
+            if cert.votes and cert.origin == self.name:
+                mine.append(cert)
+        mine.sort(key=lambda c: c.round)
+        for cert in mine[:_STALE_CAP]:
+            self._stale_certs.append(encode_primary_message(cert))
+
     async def _replay_loop(self) -> None:
         """Re-broadcast our earliest certificates forever.  Early on the
         replays are idempotent re-inserts at the peers; once the
         committee's GC horizon passes the certificates' rounds, every
         replay is a TooOld rejection — the stale-flood signal."""
+        if not self._stale_certs:
+            self._seed_stale_from_store()
         interval = max(0.01, self.plan.replay_interval_ms / 1000.0)
         i = 0
         while True:

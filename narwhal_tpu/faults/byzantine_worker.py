@@ -83,7 +83,18 @@ class ByzantineBatchMaker(BatchMaker):
         keep = self.committee.quorum_threshold() - self.committee.stake(
             self.name
         )
-        share, starved = self.plan.split_peers(list(stake_by_addr), keep)
+        # The authority-keyed favored split: aligned with the primary
+        # plane's real-header share (plan.favored_split docstring), so
+        # the under-share can never starve our own header's vote quorum.
+        share, starved = self.plan.favored_split(
+            {
+                peer_name: addrs.worker_to_worker
+                for peer_name, addrs in self.committee.others_workers(
+                    self.name, self.worker_id
+                )
+            },
+            keep,
+        )
         self._m_withheld.inc()
         log.warning(
             "FAULT withholding batch %r from %d peer(s) "

@@ -123,6 +123,14 @@ def generate(seed: int, sizes: Sequence[int] = SIZES) -> dict:
     duration = rng.choice([25, 30, 35])
     if "replay_stale" in behaviors:
         duration = max(duration, 35)
+        if nodes > 10:
+            # Staleness evidence needs the committee's COMMITTED round to
+            # clear gc_depth (8) past the replayed early rounds, and the
+            # sim stretches large-committee cadence to ~5 s rounds — at
+            # 35 s the horizon never moves and the rule provably cannot
+            # fire (sweep points 7017/7036 at N=20 sat at committed
+            # round 2 all run).  ~16 rounds is enough with margin.
+            duration = max(duration, 80)
     byz_node = rng.randrange(nodes)
     byz_entry: dict = {"node": byz_node, "behaviors": behaviors}
     if "replay_stale" in behaviors:

@@ -139,6 +139,49 @@ class Counter:
         self.value += n
 
 
+# Detection-plane counters eligible for per-node attribution (the rule
+# each feeds, for the judge's rule→observer join, lives with the sim
+# verdict code).  Only consulted at instrument CONSTRUCTION time and only
+# when a node scope is active — the production hot path never branches.
+DETECTION_COUNTERS = frozenset({
+    "primary.equivocations_detected",
+    "primary.invalid_signatures",
+    "primary.stale_messages",
+    "worker.garbage_batches",
+    "worker.helper_rejected_requests",
+})
+
+
+class _AttributedCounter:
+    """Facade pairing the shared committee-wide counter with a per-node
+    ``detect.<counter>.<node>`` shadow.  Handed out by
+    ``Registry.counter`` instead of the base counter when a node scope
+    (``Registry.node_scope``) is active at construction — which, in the
+    single-process simulation, is exactly while one authority's
+    components are being built, the only moment the observing node's
+    identity exists.  The component holds the facade; readers (health
+    rules, snapshots, tests) see the base counter through the registry
+    as always."""
+
+    __slots__ = ("_base", "_shadow")
+
+    def __init__(self, base: Counter, shadow: Counter) -> None:
+        self._base = base
+        self._shadow = shadow
+
+    @property
+    def name(self) -> str:
+        return self._base.name
+
+    @property
+    def value(self) -> int:
+        return self._base.value
+
+    def inc(self, n: int = 1) -> None:
+        self._base.value += n
+        self._shadow.value += n
+
+
 class Gauge:
     """Point-in-time value, set by the instrumented code."""
 
@@ -566,6 +609,9 @@ class Registry:
 
     def __init__(self, enabled: bool = True, trace_cap: int = 32_768) -> None:
         self.enabled = enabled
+        # Active node-attribution scope (see node_scope): None in
+        # production; the sim sets it around each authority's spawn.
+        self._node_scope: Optional[str] = None
         self.counters: Dict[str, Counter] = {}
         self.gauges: Dict[str, Gauge] = {}
         self.histograms: Dict[str, Histogram] = {}
@@ -604,7 +650,35 @@ class Registry:
         c = self.counters.get(name)
         if c is None:
             c = self.counters[name] = Counter(name)
+        if self._node_scope is not None and name in DETECTION_COUNTERS:
+            shadow = f"detect.{name}.{self._node_scope}"
+            s = self.counters.get(shadow)
+            if s is None:
+                s = self.counters[shadow] = Counter(shadow)
+            return _AttributedCounter(c, s)  # type: ignore[return-value]
         return c
+
+    def node_scope(self, label: str):
+        """Scope instrument construction to one node of an in-process
+        committee: DETECTION_COUNTERS fetched inside the scope also feed
+        a per-node ``detect.<counter>.<label>`` shadow, so a shared-
+        registry harness can name WHICH validator observed the evidence
+        behind a fired rule instead of only that the committee did.
+        Spawns are sequential in the sim, so a plain attribute (no
+        contextvar) is sufficient; production node processes never open
+        a scope and pay nothing."""
+        registry = self
+
+        class _Scope:
+            def __enter__(self):
+                self._prev = registry._node_scope
+                registry._node_scope = label
+                return registry
+
+            def __exit__(self, *exc):
+                registry._node_scope = self._prev
+
+        return _Scope()
 
     def gauge(self, name: str) -> Gauge:
         if not self.enabled:

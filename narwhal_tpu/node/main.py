@@ -144,6 +144,18 @@ def main(argv=None) -> int:
         "fails AT BOOT unless NARWHAL_CRYPTO_BACKEND_STRICT=0.",
     )
     run.add_argument(
+        "--commit-rule",
+        choices=["classic", "lowdepth"],
+        default=None,
+        help="Consensus commit rule: classic (Tusk, depth-3 commits on "
+        "f+1 support) or lowdepth (Mysticeti-style direct commit on "
+        "2f+1 support one round after the leader — judged against its "
+        "own golden oracle).  Default: the NARWHAL_COMMIT_RULE env "
+        "knob, else classic.  Committee-wide — every node must run the "
+        "same rule, and a checkpoint written under one rule refuses to "
+        "restore under the other.",
+    )
+    run.add_argument(
         "--metrics-path",
         default=None,
         help="Write a JSON metrics snapshot (atomic rewrite) to this path "
@@ -280,6 +292,14 @@ def main(argv=None) -> int:
         "Crypto backend: %s (requested %s)",
         crypto_backend.get_backend().name, requested,
     )
+    # Commit rule resolves the same way (CLI > NARWHAL_COMMIT_RULE >
+    # classic) and is logged at boot so a bench arm's logs prove which
+    # rule actually ran; garbage raises HERE, before any socket binds.
+    from ..consensus import resolve_commit_rule
+
+    logging.getLogger("narwhal.node").info(
+        "Commit rule: %s", resolve_commit_rule(args.commit_rule)
+    )
 
     async def run_node() -> None:
         # Graceful SIGTERM: set the stop event from the loop (raising out of
@@ -365,6 +385,7 @@ def main(argv=None) -> int:
                 benchmark=args.benchmark,
                 use_kernel=args.experimental_consensus_kernel,
                 fault_plan=fault_plan,
+                commit_rule=args.commit_rule,
             )
         else:
             node = await spawn_worker_node(

@@ -86,6 +86,7 @@ async def spawn_primary_node(
     consensus_cls=None,
     replay_persisted: bool = False,
     channel_capacity: Optional[int] = None,
+    commit_rule: Optional[str] = None,
 ) -> PrimaryNode:
     """Primary + Consensus pair with the GC feedback loop.  `on_commit`
     (sync callable) is the application layer — the reference's `analyze()`
@@ -150,6 +151,9 @@ async def spawn_primary_node(
             store_path + ".consensus.ckpt" if store_path else None
         ),
         audit_path=audit_path,
+        # None defers to NARWHAL_COMMIT_RULE inside Consensus; the CLI
+        # value (node run --commit-rule) arrives here already resolved.
+        commit_rule=commit_rule,
     )
     if hasattr(consensus.tusk, "prewarm"):
         log.info("Warming up consensus kernel...")

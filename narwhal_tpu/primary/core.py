@@ -211,6 +211,17 @@ class Core:
         # process_header, and each distinct twin must count ONCE — not
         # once per delivery — or the counter misreports attack magnitude.
         self.equivocation_ids: Dict[Round, Set[Tuple[PublicKey, Digest]]] = {}
+        # First VERIFIED header id seen per (round, author) — recorded at
+        # receipt, before any dependency sync.  Two validly-signed
+        # headers for one slot are a proven equivocation the moment both
+        # signatures check out; waiting for process_header's vote
+        # decision (the original witness) let a paired payload-plane
+        # attack mask the proof — the conflicting headers parked in the
+        # waiters on exactly the batches the same adversary's worker was
+        # withholding, and the fuzzed equivocate+withhold/garbage
+        # compositions sailed past the `equivocation` rule at N≥10
+        # (sim sweep points 7023/7024/7034/7035).
+        self.seen_header_ids: Dict[Round, Dict[PublicKey, Digest]] = {}
         self._m_headers_in = metrics.counter("primary.headers_processed")
         self._m_votes_in = metrics.counter("primary.votes_received")
         self._m_votes_out = metrics.counter("primary.votes_sent")
@@ -557,12 +568,37 @@ class Core:
 
     # --- main loop ----------------------------------------------------------
 
+    def _note_header_seen(self, header) -> None:
+        """Receipt-time equivocation witness: called with a header whose
+        author signature has just been verified (directly, or as part of
+        its certificate).  Recording the first id per (round, author) —
+        and counting any different verified id against it — needs no
+        payload/parent sync, so a Byzantine worker plane starving the
+        waiters cannot delay the proof past the scenario window.  Shares
+        ``equivocation_ids`` with the vote-time witness, so however many
+        paths observe one twin it counts exactly once."""
+        seen = self.seen_header_ids.setdefault(header.round, {})
+        prev = seen.setdefault(header.author, header.id)
+        if prev == header.id:
+            return
+        twin = (header.author, header.id)
+        counted = self.equivocation_ids.setdefault(header.round, set())
+        if twin not in counted:
+            counted.add(twin)
+            self._m_equivocations.inc()
+            log.warning(
+                "Equivocation by %r at round %d: first saw %r, now "
+                "offered %r (both validly signed)",
+                header.author, header.round, prev, header.id,
+            )
+
     async def _handle(self, source: str, item, sig_ok=None) -> None:
         try:
             if source == "primaries":
                 kind = item[0]
                 if kind == "header":
                     self.sanitize_header(item[1], sig_ok)
+                    self._note_header_seen(item[1])
                     # lint: allow-interleave(window mode runs _handle from two roots — run() for waiter/proposer sources, _verify_loop for peer messages — over the per-round maps and aggregators: every decision+record pair (vote-once via last_voted/voted_ids, equivocation counting, aggregator append) happens in one sync block BEFORE any yield, the aggregators dedupe by authority, and sanitize_* re-checks round state at replay time, so a cross-root suspension can reorder processing but never tear an invariant)
                     await self.process_header(item[1])
                 elif kind == "vote":
@@ -573,6 +609,12 @@ class Core:
                     await self.process_vote(item[1])
                 elif kind == "certificate":
                     self.sanitize_certificate(item[1], sig_ok)
+                    # The embedded header's signature is one of the
+                    # certificate's verified claims — a twin-voter whose
+                    # directly-received twin is still parked on payload
+                    # sync proves the equivocation HERE, when the real
+                    # header's certificate arrives.
+                    self._note_header_seen(item[1].header)
                     await self.process_certificate(item[1])
                 else:
                     log.warning("Unexpected core message %r", kind)
@@ -625,6 +667,7 @@ class Core:
             for m in (
                 self.last_voted,
                 self.voted_ids,
+                self.seen_header_ids,
                 self.own_header_ids,
                 self.counted_votes,
                 self.equivocation_ids,

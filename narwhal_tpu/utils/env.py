@@ -112,6 +112,16 @@ _VARS = [
         "Path for the consensus insert/commit audit segment consumed by "
         "the golden-oracle safety replay; unset = no audit log.",
     ),
+    EnvVar(
+        "NARWHAL_COMMIT_RULE", "str", "classic",
+        "Commit rule (equivalent of `node run --commit-rule`): `classic` "
+        "(Tusk — leader commits at depth 3 on f+1 support) or `lowdepth` "
+        "(Mysticeti-style — leader commits the moment 2f+1 round-(L+1) "
+        "certificates cite it, judged against its own frozen oracle). "
+        "Committee-wide: mixed-rule committees diverge by design and "
+        "fail the safety replay; checkpoints refuse a cross-rule "
+        "restore.",
+    ),
     # -- observability --------------------------------------------------------
     EnvVar(
         "NARWHAL_METRICS", "flag", True,

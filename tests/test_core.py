@@ -878,3 +878,93 @@ def test_equivocation_counted_once_per_twin(run):
         core.network.close()
 
     run(go())
+
+
+def test_equivocation_proven_at_verified_receipt_before_payload_sync(run):
+    """The receipt-time witness (PR 15): two validly-signed headers for
+    one (round, author) slot are a proven equivocation the moment both
+    signatures check out — BEFORE any payload/parent sync completes.
+    Both headers here reference a batch the store does not hold, so
+    process_header parks them in the waiter; the vote-time witness alone
+    never fires (the masking that let equivocate+withhold compositions
+    sail past the `equivocation` rule at N≥10 in the sim sweep)."""
+
+    async def go():
+        c = committee()
+        me, author = keys()[0], keys()[1]
+        core, store, qs = make_core(c, me)
+        missing = {digest32(b"never-sealed"): 0}
+        g = sorted(x.digest() for x in genesis(c))
+        h1 = make_header(author, payload=dict(missing), parents=set(g), c=c)
+        twin = make_header(
+            author, payload=dict(missing), parents=set(g[:3]), c=c
+        )
+        assert h1.id != twin.id
+        base = core._m_equivocations.value
+
+        await core._handle("primaries", ("header", h1), sig_ok=True)
+        # Parked on the missing batch: no vote was emitted, so the
+        # vote-time witness holds nothing for this slot.
+        assert author not in core.last_voted.get(1, set())
+        await core._handle("primaries", ("header", twin), sig_ok=True)
+        assert core._m_equivocations.value == base + 1
+        # Re-delivery still counts once.
+        await core._handle("primaries", ("header", twin), sig_ok=True)
+        assert core._m_equivocations.value == base + 1
+        core.network.close()
+
+    run(go())
+
+
+def test_certificate_embedded_header_proves_equivocation(run):
+    """A twin-voter that only ever received the twin DIRECTLY proves the
+    equivocation when the real header's CERTIFICATE arrives (the
+    embedded header's signature is one of the certificate's verified
+    claims) — the evidence path that crosses the adversary's disjoint
+    peer split."""
+
+    async def go():
+        c = committee()
+        me, author = keys()[0], keys()[1]
+        core, store, qs = make_core(c, me)
+        g = sorted(x.digest() for x in genesis(c))
+        real = make_header(author, parents=set(g), c=c)
+        twin = make_header(author, parents=set(g[:3]), c=c)
+        base = core._m_equivocations.value
+
+        # We saw only the twin (and voted for it).
+        await core._handle("primaries", ("header", twin), sig_ok=True)
+        assert core._m_equivocations.value == base
+        # The real header reaches us only inside its certificate.
+        await core._handle(
+            "primaries", ("certificate", make_certificate(real)),
+            sig_ok=True,
+        )
+        assert core._m_equivocations.value == base + 1
+        core.network.close()
+
+    run(go())
+
+
+def test_forged_header_never_feeds_the_receipt_witness(run):
+    """A header whose signature FAILED verification must not seed (or
+    trip) the receipt-time witness: invalid statements prove nothing."""
+
+    async def go():
+        c = committee()
+        me, author = keys()[0], keys()[1]
+        core, store, qs = make_core(c, me)
+        g = sorted(x.digest() for x in genesis(c))
+        forged = make_header(author, parents=set(g[:3]), c=c)
+        real = make_header(author, parents=set(g), c=c)
+        base = core._m_equivocations.value
+
+        await core._handle("primaries", ("header", forged), sig_ok=False)
+        assert core._m_invalid_sigs.value >= 1
+        await core._handle("primaries", ("header", real), sig_ok=True)
+        # The forged twin never entered the witness, so the real header
+        # is the FIRST seen id — no equivocation.
+        assert core._m_equivocations.value == base
+        core.network.close()
+
+    run(go())

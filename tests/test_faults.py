@@ -647,13 +647,25 @@ def test_byzantine_plan_roundtrip_and_split():
         }
     )
     assert plan.withhold_targets == {kps[1].name}
-    # Deterministic under the same seed, and keep+rest partitions the set.
-    addrs = [f"10.0.0.{i}:7000" for i in range(5)]
-    a1, b1 = plan.split_peers(addrs, 3)
+    # Deterministic under the same seed, keep+rest partitions the set,
+    # and two independently-loaded plans (one per role process) agree —
+    # the coordination the favored split exists for.
+    addr_by_name = {f"auth{i}": f"10.0.0.{i}:7000" for i in range(5)}
+    a1, b1 = plan.favored_split(addr_by_name, 3)
     plan2 = ByzantinePlan.from_json({"behaviors": ["equivocate"], "seed": 9})
-    a2, b2 = plan2.split_peers(addrs, 3)
-    assert len(a1) == 3 and sorted(a1 + b1) == sorted(addrs)
+    a2, b2 = plan2.favored_split(addr_by_name, 3)
+    assert len(a1) == 3 and sorted(a1 + b1) == sorted(addr_by_name.values())
     assert (a1, b1) == (a2, b2)
+    # A different address PLANE of the same authorities splits to the
+    # same names (prefix-aligned), and a different seed re-deals.
+    other_plane = {n: f"10.0.1.{i}:8000" for i, n in enumerate(sorted(addr_by_name))}
+    c1, _ = plan.favored_split(other_plane, 3)
+    assert {a.split(":")[0].rsplit(".", 1)[1] for a in a1} == {
+        c.split(":")[0].rsplit(".", 1)[1] for c in c1
+    }
+    plan3 = ByzantinePlan.from_json({"behaviors": ["equivocate"], "seed": 10})
+    deals = {tuple(plan3.favored_split(addr_by_name, 3)[0]), tuple(a1)}
+    assert len(deals) == 2
 
     with pytest.raises(Exception):
         ByzantinePlan.from_json({"behaviors": ["teleport"]})

@@ -516,3 +516,46 @@ def test_cross_validate_carries_round_attribution():
     assert math.isclose(
         summary["round_attribution"]["round_period_ms"], 100.0, abs_tol=0.01
     )
+
+
+def test_node_scope_attributes_detection_counters():
+    """Registry.node_scope (PR 15): DETECTION_COUNTERS fetched inside a
+    scope return a facade feeding both the shared counter and a
+    per-node `detect.<counter>.<label>` shadow; ordinary counters and
+    out-of-scope fetches are untouched, and production (no scope) hands
+    out the plain counter object."""
+    from narwhal_tpu.metrics import DETECTION_COUNTERS, Registry
+
+    reg = Registry()
+    name = "primary.equivocations_detected"
+    assert name in DETECTION_COUNTERS
+
+    plain = reg.counter(name)
+    plain.inc()
+    with reg.node_scope("primary-0"):
+        a = reg.counter(name)
+        other = reg.counter("primary.headers_processed")
+        a.inc(2)
+        other.inc()
+    with reg.node_scope("primary-1"):
+        b = reg.counter(name)
+        b.inc(3)
+    # Shared counter aggregates everything; facade .value reads through.
+    assert reg.counters[name].value == 6
+    assert a.value == 6 and a.name == name
+    # Shadows split by node; the non-detection counter grew no shadow.
+    assert reg.counters[f"detect.{name}.primary-0"].value == 2
+    assert reg.counters[f"detect.{name}.primary-1"].value == 3
+    assert not any(
+        n.startswith("detect.primary.headers_processed") for n in reg.counters
+    )
+    # Outside any scope the plain counter object is returned (and incs
+    # recorded through an earlier facade landed on the same object).
+    again = reg.counter(name)
+    assert again is reg.counters[name]
+    # A scope held across an inc after exit still writes the shadow (the
+    # facade captured its node at construction — by design: components
+    # fetch at init inside the scope and inc forever after).
+    a.inc()
+    assert reg.counters[f"detect.{name}.primary-0"].value == 3
+    assert reg.counters[name].value == 7

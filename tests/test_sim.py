@@ -18,6 +18,8 @@ import logging
 import os
 import sys
 
+import pytest
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from narwhal_tpu.faults.fuzz import SIZES, generate  # noqa: E402
@@ -145,6 +147,15 @@ def test_planted_byzantine_is_detected_without_being_expected(tmp_path):
     assert "equivocation" in art["verdicts"]["detection"]["fired"]
     # And safety holds: equivocation must never doubly commit.
     assert art["verdicts"]["safety"]["ok"], art["verdicts"]["safety"]
+    # Per-node attribution (PR 15): the verdict names WHICH validators
+    # observed the evidence — honest peers, never the adversary itself
+    # (it holds only its own statements, no conflicting pair).
+    observers = art["verdicts"]["detection"]["observers"].get(
+        "equivocation", []
+    )
+    assert observers, art["verdicts"]["detection"]
+    assert "primary-1" not in observers
+    assert all(o.startswith("primary-") for o in observers)
 
 
 _RACY_SPEC = {
@@ -169,6 +180,42 @@ def test_planted_racy_consensus_fails_a_safety_verdict(tmp_path):
         "planted RacyConsensus was not caught at the pinned seed — "
         "the sim harness's safety verdict went blind"
     )
+
+
+@pytest.mark.parametrize("rule", ["classic", "lowdepth"])
+def test_planted_corruption_fails_safety_under_both_rules(tmp_path, rule):
+    """The deterministic honesty arm (ISSUE 15): node 0 running
+    ``CorruptingConsensus`` (one dropped + one re-committed certificate)
+    must fail the safety verdict on the FIRST schedule under EITHER
+    commit rule — the proof that each arm of a flag-flip sweep judges
+    its sequences against its own oracle, which the schedule-dependent
+    racy plant cannot give for lowdepth (its await-window race needs
+    classic's deep commit backlogs to manifest at sim exploration
+    intensity)."""
+    from benchmark.sim_bench import CorruptingConsensus
+
+    spec = {
+        "name": "sim_mut_corrupt", "nodes": 4, "workers": 1, "rate": 600,
+        "tx_size": 256, "duration": 15, "seed": 7_000 ^ 0xC0DE,
+    }
+    art = run_sim_scenario(
+        parse_scenario(spec, env={}), 29_000,
+        str(tmp_path / "corrupt"),
+        consensus_cls_by_node={0: CorruptingConsensus},
+        commit_rule=rule,
+    )
+    safety = art["verdicts"]["safety"]
+    assert not safety["ok"], (
+        f"planted sequence corruption was not caught under {rule} — "
+        "the arm's oracle is not judging its own sequences"
+    )
+    violations = [
+        v
+        for nv in safety["nodes"].values()
+        for v in nv.get("violations", [])
+    ]
+    assert any("committed twice" in v or "diverges" in v
+               for v in violations), violations
 
 
 def test_crash_restart_authority_recovers(tmp_path):
