@@ -12,8 +12,8 @@ PYTHON ?= python
 
 .PHONY: check native lint lint-invariants test test-ci metrics-smoke \
 	trace-smoke fault-smoke fault-fuzz-smoke trajectory race-explore \
-	sim-smoke wire-ab-smoke crypto-ab-smoke commit-rule-smoke sanitize \
-	bench clean
+	sim-smoke wire-ab-smoke crypto-ab-smoke commit-rule-smoke \
+	knee-matrix knee-smoke sanitize bench clean
 
 check: native lint test
 
@@ -189,6 +189,21 @@ commit-rule-smoke:
 		--points 20 --commit-rule both --mutation-seeds 8 \
 		--workdir .sim_commit_rule \
 		--artifact .ci-artifacts/sim-commit-rule-flip.json --quiet
+
+# Saturation-knee matrix (ISSUE 17): sweep offered load across
+# committee sizes (socketed N=4, sim N=10/20), locate each config's
+# TPS/latency knee, and name the first-saturating inter-task channel
+# at the knee from the InstrumentedQueue accounting.  The full matrix
+# is a release artifact (artifacts/knee_matrix_<rev>.json); knee-smoke
+# is the 2-point N=4 CI arm, gated on a non-empty queue attribution.
+knee-matrix: native
+	JAX_PLATFORMS=cpu $(PYTHON) benchmark/knee_matrix.py
+
+knee-smoke:
+	mkdir -p .ci-artifacts
+	JAX_PLATFORMS=cpu $(PYTHON) benchmark/knee_matrix.py \
+		--smoke --duration 8 \
+		--out .ci-artifacts/knee-smoke.json
 
 # Asyncio sanitizer tier (ISSUE 10): the fast concurrency-sensitive
 # tier-1 subset under `python -X dev` — asyncio debug mode with the

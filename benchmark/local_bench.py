@@ -39,6 +39,7 @@ from benchmark.metrics_check import (  # noqa: E402
     check_quiesce_health,
     cross_validate,
     load_snapshots,
+    queue_pressure_summary,
     wire_crypto_summary,
 )
 from benchmark.scraper import Scraper  # noqa: E402
@@ -528,6 +529,12 @@ def run_bench(
             quorum_weight=committee.quorum_threshold(),
         )
         result.wire, result.crypto = wc["wire"], wc["crypto"]
+        # Per-channel backpressure accounting: the scraper's 1 Hz sample
+        # timeline gives first_saturating a WHEN; the final snapshots
+        # give every channel its totals either way.
+        result.queues = queue_pressure_summary(
+            snapshots, scraper.samples if scraper else []
+        )
         check_quiesce_health(healthz, result.errors)
         result.timeline = build_timeline(
             scraper.samples if scraper else [],
@@ -693,6 +700,9 @@ def main():
                     # Per-node flight-recorder rings pulled at quiesce
                     # (/debug/flight): the last-seconds event history.
                     "flight": result.flight,
+                    # Per-channel queue backpressure accounting + the
+                    # first-saturating attribution (knee matrix input).
+                    "queues": result.queues,
                 }
             )
         )
@@ -786,6 +796,30 @@ def main():
                 f"{len(result.timeline.get('rtt_ms', {}))} nodes "
                 "(full series in .bench/timeline.json)"
             )
+        if result.queues.get("channels"):
+            fs = result.queues.get("first_saturating") or {}
+            hot = sorted(
+                result.queues["channels"].items(),
+                key=lambda kv: kv[1].get("utilization", 0.0),
+                reverse=True,
+            )[:3]
+            print(
+                f" + QUEUES: {len(result.queues['channels'])} channels"
+                + (
+                    f", most pressured {fs['channel']} ({fs['mode']})"
+                    if fs
+                    else ""
+                )
+            )
+            for ch, a in hot:
+                if not a.get("high_water"):
+                    continue
+                print(
+                    f"   {ch}: high-water {a['high_water']}/"
+                    f"{a['capacity'] or '∞'}"
+                    f" ({a.get('utilization', 0.0):.0%}),"
+                    f" {a['enqueued']:,} enq, {a['full']:,} full"
+                )
 
 
 if __name__ == "__main__":

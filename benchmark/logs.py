@@ -120,6 +120,10 @@ class ParseResult:
     # (benchmark/scraper.py flight_all): {node: {"events": […], …}} —
     # the last-seconds event history every run carries, clean or not.
     flight: Dict = field(default_factory=dict)
+    # Per-channel InstrumentedQueue backpressure accounting
+    # (metrics_check.queue_pressure_summary): per-node channel tables,
+    # committee-wide aggregates, and the first-saturating attribution.
+    queues: Dict = field(default_factory=dict)
 
     def summary(self, rate: int, tx_size: int, nodes: int, workers: int) -> str:
         return (

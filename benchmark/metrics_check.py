@@ -242,6 +242,7 @@ def round_attribution(snapshots: List[dict]) -> dict:
     }
     periods: List[float] = []
     hist_sum, hist_count = 0.0, 0
+    sa_sum, sa_count = 0.0, 0
     for snap in snapshots:
         if not snap.get("enabled", True):
             continue
@@ -251,6 +252,12 @@ def round_attribution(snapshots: List[dict]) -> dict:
         if h and h.get("count"):
             hist_sum += h["sum"]
             hist_count += h["count"]
+        sa = (snap.get("histograms") or {}).get(
+            "consensus.support_arrival_ms"
+        )
+        if sa and sa.get("count"):
+            sa_sum += sa["sum"]
+            sa_count += sa["count"]
         entries: Dict[int, dict] = {}
         for key, st in (snap.get("round_trace") or {}).items():
             try:
@@ -305,6 +312,15 @@ def round_attribution(snapshots: List[dict]) -> dict:
                         "mis-stamped",
                         file=sys.stderr,
                     )
+    if sa_count:
+        # Support-arrival spread (consensus side of the cadence story):
+        # per committed-path leader, first direct supporter → the 2f+1
+        # quorum-crossing arrival.  The gap between this and the round
+        # period bounds what a lower-depth commit rule can save.
+        out["support_arrival_ms"] = {
+            "leaders": sa_count,
+            "mean": round(sa_sum / sa_count, 3),
+        }
     return out
 
 
@@ -345,6 +361,142 @@ def _agg_histograms(snapshots: List[dict]) -> Dict[str, Tuple[float, int]]:
                 continue
             s, c = out.get(name, (0.0, 0))
             out[name] = (s + (h.get("sum") or 0.0), c + (h.get("count") or 0))
+    return out
+
+
+# -- queue & backpressure accounting ------------------------------------------
+
+def queue_pressure_summary(
+    snapshots: List[dict],
+    samples: Optional[List[dict]] = None,
+    saturation_ratio: float = 0.8,
+) -> dict:
+    """Join the per-channel ``queue.<channel>.*`` series (emitted by
+    ``metrics.InstrumentedQueue``) into the bench JSON ``queues``
+    section.
+
+    ``nodes`` keys each process (by snapshot pid) to its channel table —
+    capacity, final depth, high-water, enqueue/dequeue/QueueFull totals,
+    mean blocked-put wait and mean queue residence.  ``channels``
+    aggregates committee-wide (max high-water/utilization, summed
+    counters).  ``first_saturating`` is the knee attribution: with the
+    scraper's 1 Hz ``samples`` timeline it names the channel whose depth
+    first crossed ``saturation_ratio`` of capacity and WHEN; without a
+    timeline it falls back to the channel with the highest end-of-run
+    high-water utilization, PROVIDED that utilization itself crossed
+    ``saturation_ratio`` — an unsaturated run honestly reports no
+    attribution rather than electing whichever channel happened to sit
+    deepest.  Unbounded channels (capacity 0) never saturate and are
+    reported without a utilization.  Narrow pipeline windows like
+    ``worker.to_quorum`` (capacity = QUORUM_WINDOW) are deliberately
+    NOT excluded here, unlike in the queue_saturated health rule: the
+    admission window pegging at capacity while the wide channels stay
+    empty IS a knee explanation (backpressure propagated upstream of
+    the node), and the health rule's min-capacity floor exists only to
+    keep steady-state alerts quiet."""
+    per_node: Dict[str, dict] = {}
+    for snap in snapshots:
+        if not snap.get("enabled", True):
+            continue
+        gauges = snap.get("gauges") or {}
+        counters = snap.get("counters") or {}
+        hists = snap.get("histograms") or {}
+        channels: Dict[str, dict] = {}
+        for name, depth in gauges.items():
+            if not (name.startswith("queue.") and name.endswith(".depth")):
+                continue
+            ch = name[len("queue."):-len(".depth")]
+            base = f"queue.{ch}."
+            cap = float(gauges.get(base + "capacity") or 0)
+            hw = float(gauges.get(base + "high_water") or 0)
+            entry = {
+                "capacity": int(cap),
+                "depth": int(depth or 0),
+                "high_water": int(hw),
+                "enqueued": int(counters.get(base + "enqueued") or 0),
+                "dequeued": int(counters.get(base + "dequeued") or 0),
+                "full": int(counters.get(base + "full") or 0),
+            }
+            if cap > 0:
+                entry["utilization"] = round(hw / cap, 4)
+            pw = hists.get(base + "put_wait_seconds") or {}
+            if pw.get("count"):
+                entry["put_waits"] = int(pw["count"])
+                entry["put_wait_ms_mean"] = round(
+                    1000 * pw["sum"] / pw["count"], 3
+                )
+            res = hists.get(base + "residence_seconds") or {}
+            if res.get("count"):
+                entry["residence_ms_mean"] = round(
+                    1000 * res["sum"] / res["count"], 3
+                )
+            channels[ch] = entry
+        if channels:
+            # Final snapshot files carry a pid; scraped samples (the
+            # remote harness's snapshot proxy) carry the node name.
+            key = snap.get("pid") or snap.get("node") or len(per_node)
+            per_node[str(key)] = channels
+
+    agg: Dict[str, dict] = {}
+    for channels in per_node.values():
+        for ch, e in channels.items():
+            a = agg.setdefault(
+                ch,
+                {
+                    "capacity": 0, "high_water": 0,
+                    "enqueued": 0, "dequeued": 0, "full": 0,
+                },
+            )
+            a["capacity"] = max(a["capacity"], e["capacity"])
+            a["high_water"] = max(a["high_water"], e["high_water"])
+            for k in ("enqueued", "dequeued", "full"):
+                a[k] += e[k]
+            if "utilization" in e:
+                a["utilization"] = max(
+                    a.get("utilization", 0.0), e["utilization"]
+                )
+
+    out: dict = {"nodes": per_node, "channels": agg}
+
+    first: Optional[Tuple[float, str, float]] = None
+    t0: Optional[float] = None
+    for s in samples or ():
+        t = s.get("t")
+        g = s.get("gauges") or {}
+        if t is None:
+            continue
+        if t0 is None or t < t0:
+            t0 = float(t)
+        for name, depth in g.items():
+            if not (name.startswith("queue.") and name.endswith(".depth")):
+                continue
+            ch = name[len("queue."):-len(".depth")]
+            cap = g.get(f"queue.{ch}.capacity") or 0
+            if not cap or not depth:
+                continue
+            if depth >= saturation_ratio * cap and (
+                first is None or t < first[0]
+            ):
+                first = (float(t), ch, depth / cap)
+    if first is not None:
+        out["first_saturating"] = {
+            "channel": first[1],
+            # Seconds since the first scrape sample, not absolute time.
+            "at_s": round(first[0] - (t0 or first[0]), 2),
+            "fill_ratio": round(first[2], 3),
+            "mode": "timeline",
+        }
+    else:
+        best_ch, best_u = None, 0.0
+        for ch, a in agg.items():
+            if a.get("utilization", 0.0) > best_u:
+                best_ch, best_u = ch, a["utilization"]
+        if best_ch is not None and best_u >= saturation_ratio:
+            out["first_saturating"] = {
+                "channel": best_ch,
+                "utilization": round(best_u, 4),
+                "mode": "high_water",
+            }
     return out
 
 
@@ -616,7 +768,8 @@ def build_timeline(
          "nodes": {name: [{"t", "round", "commit_lag", "commits",
                            "committed_batches", "txs_sealed",
                            "pending_acks", "health_firing",
-                           "commit_rate_per_s", "txs_sealed_per_s"}, …]},
+                           "commit_rate_per_s", "txs_sealed_per_s",
+                           "queues": {channel: depth}}, …]},
          "events": [{"node", "t", "event": "FIRING"|"cleared", "rule",
                      "subject", "detail"}, …],   # anomaly transitions
          "rtt_ms": {name: {peer_addr: {"mean_ms", "count"}}},
@@ -690,6 +843,16 @@ def build_timeline(
                 "pending_acks": gauges.get("net.reliable.pending_acks"),
                 "health_firing": len(health.get("firing", [])),
             }
+            # Non-empty InstrumentedQueue depths at this tick: the
+            # per-channel series a knee reads as a FILLING queue on the
+            # timeline (and the Perfetto queue-depth counter tracks).
+            qdepth = {
+                g[len("queue."):-len(".depth")]: v
+                for g, v in gauges.items()
+                if g.startswith("queue.") and g.endswith(".depth") and v
+            }
+            if qdepth:
+                point["queues"] = qdepth
             if prev is not None and s["t"] > prev["t"]:
                 dt = s["t"] - prev["t"]
                 for rate_key, src_key in (

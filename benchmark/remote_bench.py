@@ -50,6 +50,7 @@ from benchmark.logs import parse_logs  # noqa: E402
 from benchmark.metrics_check import (  # noqa: E402
     build_timeline,
     check_quiesce_health,
+    queue_pressure_summary,
     wire_crypto_summary,
 )
 from benchmark.scraper import Scraper  # noqa: E402
@@ -456,6 +457,11 @@ def run_remote_bench(
         quorum_weight=committee.quorum_threshold(),
     )
     result.wire, result.crypto = wc["wire"], wc["crypto"]
+    # Per-channel backpressure accounting: last samples as the snapshot
+    # proxy (totals), the full 1 Hz timeline for first_saturating.
+    result.queues = queue_pressure_summary(
+        list(last_sample.values()), scraper.samples
+    )
     result.flight = flight_rings
     with open(f"{stage}/timeline.json", "w") as f:
         json.dump(result.timeline, f, indent=1)
@@ -564,6 +570,7 @@ def main() -> None:
                     "crypto": result.crypto,
                     "timeline": result.timeline,
                     "flight": result.flight,
+                    "queues": result.queues,
                 }
             )
         )

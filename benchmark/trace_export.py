@@ -356,6 +356,16 @@ def _emit_timeline(b, pids, timeline: dict, t0) -> None:
                     vals[key] = v
             if vals:
                 b.counter_track(pid, "scraped rates", _us(t, t0), vals)
+            # Per-channel InstrumentedQueue depths: their own counter
+            # track per node, so a saturation knee reads as a filling
+            # queue directly on the timeline.
+            qvals = {
+                ch: v
+                for ch, v in (point.get("queues") or {}).items()
+                if isinstance(v, (int, float))
+            }
+            if qvals:
+                b.counter_track(pid, "queue depth", _us(t, t0), qvals)
     for ev in timeline.get("events") or []:
         pid = pids.get(ev.get("node"))
         t = ev.get("t")

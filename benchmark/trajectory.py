@@ -184,6 +184,25 @@ def load_bench_file(path: str) -> Tuple[Optional[Dict[str, float]], str]:
     if not isinstance(d, dict):
         return None, "malformed: not a JSON object"
 
+    # Knee matrix (benchmark/knee_matrix): per-committee-size saturation
+    # knees.  Flattened to knee.n<N>.{rate,tps,latency_ms} — attribution
+    # metrics (artifacts/ placement → attr. namespace, never gated); the
+    # first-saturating channel names live in the artifact itself.
+    if d.get("generated_by") == "benchmark/knee_matrix":
+        metrics: Dict[str, float] = {}
+        for cfg in d.get("configs") or []:
+            n = cfg.get("n")
+            knee = cfg.get("knee") or {}
+            if not isinstance(n, int) or not knee:
+                continue
+            for key in ("rate", "tps", "latency_ms"):
+                v = _num(knee.get(key))
+                if v is not None:
+                    metrics[f"knee.n{n}.{key}"] = v
+        if metrics:
+            return metrics, "ok (knee matrix)"
+        return None, "knee matrix without located knees"
+
     # Driver wrapper: {n, cmd, rc, tail, parsed}.
     if "parsed" in d and "cmd" in d:
         rc = d.get("rc")

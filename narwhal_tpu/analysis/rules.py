@@ -338,7 +338,7 @@ _CTX_EXACT_FNS = {"counter", "gauge", "rate", "last_change_age"}
 _CTX_PREFIX_FNS = {"gauges_prefixed", "rates_prefixed"}
 _METRIC_ROOTS = (
     "primary", "worker", "consensus", "net", "store", "crypto", "wire",
-    "metrics", "faults", "runtime", "profile", "flight",
+    "metrics", "faults", "runtime", "profile", "flight", "queue",
 )
 _METRIC_NAME_RE = re.compile(
     r"(?:%s)(?:\.[a-z0-9_]+)+\.?" % "|".join(_METRIC_ROOTS)
@@ -351,6 +351,10 @@ METRIC_ALLOWLIST: Dict[str, str] = {
     "wire.": "WireLedger builds wire.<dir>.{frames,bytes}.<type> (and the "
     "retransmit_ variants) at account time from the msg_type labels that "
     "rule wire-type-coverage pins",
+    "queue.": "InstrumentedQueue builds queue.<channel>.{depth,capacity,"
+    "high_water,enqueued,dequeued,full,put_wait_seconds,residence_seconds} "
+    "from the channel name passed at construction (channel table in "
+    "README 'Queue & backpressure accounting')",
 }
 
 _CONSUMER_FILES = (

@@ -46,7 +46,7 @@ import os
 import struct
 import tempfile
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 from .. import metrics
 from ..config import Committee
@@ -266,6 +266,15 @@ class Tusk:
         # walk's from-scratch rescan of the child round (the rare
         # equivocation-overwrite path recomputes instead of patching).
         self._support: Dict[Round, int] = {}
+        # Optional hook fired from the incremental bump with
+        # (leader_round, old_stake, new_stake) — Consensus attaches its
+        # support-arrival-spread accounting here.  Only the hot
+        # incremental path fires it: the cold recompute paths
+        # (leader-after-supporters, equivocation overwrite) reconstruct
+        # stake totals but not arrival ORDER, so they stay silent.
+        self.support_observer: Optional[
+            Callable[[Round, int, int], None]
+        ] = None
 
     def leader(self, round: Round, dag: Dag) -> Optional[Tuple[Digest, Certificate]]:
         """Round-robin leader (a common coin in the full protocol —
@@ -292,9 +301,11 @@ class Tusk:
                 # This certificate may support the leader of round r-1.
                 got = self.leader(r - 1, self.state.dag)
                 if got is not None and got[0] in certificate.header.parents:
-                    self._support[r - 1] = self._support.get(
-                        r - 1, 0
-                    ) + self.committee.stake(certificate.origin)
+                    old = self._support.get(r - 1, 0)
+                    new = old + self.committee.stake(certificate.origin)
+                    self._support[r - 1] = new
+                    if self.support_observer is not None:
+                        self.support_observer(r - 1, old, new)
             elif (
                 r % 2 == 0
                 and r >= 2
@@ -659,6 +670,29 @@ class Consensus:
         self._m_round = metrics.gauge("consensus.last_committed_round")
         self._m_lag = metrics.gauge("consensus.commit_lag_rounds")
         self._mtrace = metrics.trace()
+        # Support-arrival spread: per leader round, the loop-clock span
+        # from the FIRST direct supporter landing to the arrival that
+        # crossed the 2f+1 quorum line — how long a lower-depth commit
+        # rule would wait past first contact (the multi-leader flip's
+        # before-number).  Driven from Tusk's incremental support bump,
+        # so it measures arrival ORDER on the same clock cert_to_commit
+        # uses: wall time on a live node, virtual time under the sim.
+        self._m_support_arrival = metrics.histogram(
+            "consensus.support_arrival_ms", metrics.LATENCY_MS_BUCKETS
+        )
+        self._support_first: Dict[Round, float] = {}
+        if self._c2c_on:
+            _quorum = committee.quorum_threshold()
+
+            def _observe_support(
+                leader_round: Round, old_stake: int, new_stake: int
+            ) -> None:
+                now = loop_now()
+                first = self._support_first.setdefault(leader_round, now)
+                if old_stake < _quorum <= new_stake:
+                    self._m_support_arrival.observe(1000.0 * (now - first))
+
+            self.tusk.support_observer = _observe_support
         # Crash-recovery of the committed frontier (beyond reference
         # parity — it leaves consensus state unpersisted,
         # consensus/src/lib.rs:18-19).  The checkpoint is its own small
@@ -869,6 +903,16 @@ class Consensus:
                         if r < horizon
                     ]:
                         del self._insert_ts[d]
+            if self._c2c_on and len(self._support_first) > self._c2c_cap:
+                # Same horizon logic as _insert_ts: first-arrival stamps
+                # for leader rounds the DAG head has outrun can never
+                # see another supporter (those inserts are GC-dropped).
+                horizon = self._insert_head - self.tusk.gc_depth
+                if horizon > 0:
+                    for lr in [
+                        lr for lr in self._support_first if lr < horizon
+                    ]:
+                        del self._support_first[lr]
             if self._audit is not None:
                 # One flush per drained burst: the burst's 'I' and 'C'
                 # records land (or tear) together, which is what lets the

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import asyncio
 
+from .. import metrics
 from ..utils.tasks import spawn
 from typing import Optional, Tuple
 
@@ -30,7 +31,13 @@ class SignatureService:
         # Re-arm if never started, the task died, or we moved to a new loop
         # (e.g. successive asyncio.run calls in tests).
         if self._task is None or self._task.done() or self._loop is not loop:
-            self._queue = asyncio.Queue()
+            # Unbounded (capacity 0: never saturates, reported without a
+            # utilization) — its residence histogram is the sign-request
+            # queue wait, the number that shows when the single signer
+            # actor becomes the backlog.
+            self._queue = metrics.InstrumentedQueue(
+                channel="crypto.sign_service"
+            )
             self._loop = loop
             self._task = spawn(self._run(self._queue), name="signature-service")
 

@@ -75,6 +75,13 @@ COUNT_BUCKETS: Tuple[float, ...] = (
     1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024,
 )
 
+# Millisecond-scaled latency buckets for series published in ms
+# (consensus.support_arrival_ms): same spread as LATENCY_BUCKETS, 1 ms
+# to 10 s, so the two families bucket identically up to the unit.
+LATENCY_MS_BUCKETS: Tuple[float, ...] = tuple(
+    1000.0 * b for b in LATENCY_BUCKETS
+)
+
 # Pipeline stages, in causal order.  TraceTable.mark validates against this
 # so a typo'd stage name fails loudly in tests instead of silently skewing
 # the bench breakdown.  The last four stages subdivide the old opaque
@@ -501,6 +508,16 @@ class FlightRecorder:
         acks = reg.gauges.get("net.reliable.pending_acks")
         if acks is not None:
             gauges["pending_acks"] = acks.value
+        # InstrumentedQueue depths: only the non-empty channels, so the
+        # ring entry stays small in steady state and a filling queue is
+        # visible in the last-seconds record a crash dump preserves.
+        qdepth = {
+            n[len("queue."):-len(".depth")]: g.value
+            for n, g in reg.gauges.items()
+            if n.startswith("queue.") and n.endswith(".depth") and g.value
+        }
+        if qdepth:
+            gauges["queues"] = qdepth
         self.record("tick", d=deltas, **gauges)
 
     async def run(self, interval_s: Optional[float] = None) -> None:
@@ -989,6 +1006,17 @@ def default_rules(env: Optional[Mapping[str, str]] = None) -> List[HealthRule]:
     # silent; withholding scenarios lower it alongside a raised retry
     # delay to make the starvation unambiguous.
     sync_age_max = f("NARWHAL_HEALTH_SYNC_AGE_S", 8)
+    # Backpressure plane (InstrumentedQueue channels).  A channel reads
+    # as saturated when its live depth crosses RATIO of capacity; the
+    # MIN_CAP floor excludes channels that run full BY DESIGN — the
+    # worker's QUORUM_WINDOW admission queue (depth 8) and the sim's
+    # depth-1 race-forcing channels use fullness as their backpressure
+    # MECHANISM, so fullness there is operation, not anomaly.
+    queue_sat_ratio = f("NARWHAL_HEALTH_QUEUE_SAT_RATIO", 0.9)
+    queue_sat_min_cap = f("NARWHAL_HEALTH_QUEUE_SAT_MIN_CAP", 16)
+    queue_sat_intervals = f("NARWHAL_HEALTH_QUEUE_SAT_INTERVALS", 3)
+    ingress_drop_rate = f("NARWHAL_HEALTH_INGRESS_DROP_RATE", 1.0)
+    ingress_drop_window = f("NARWHAL_HEALTH_INGRESS_DROP_WINDOW_S", 5)
 
     def commit_lag(ctx: HealthContext) -> Dict[str, dict]:
         v = ctx.gauge("consensus.commit_lag_rounds")
@@ -1162,6 +1190,49 @@ def default_rules(env: Optional[Mapping[str, str]] = None) -> List[HealthRule]:
                 }
         return out
 
+    def queue_saturated(ctx: HealthContext) -> Dict[str, dict]:
+        # One subject per channel, so a firing names the saturating
+        # channel directly — the health-side mirror of the knee matrix's
+        # first_saturating attribution.  Depth and capacity are the
+        # plain gauges InstrumentedQueue maintains on every put/get.
+        out = {}
+        prefixed = ctx.gauges_prefixed("queue.")
+        for name, depth in prefixed.items():
+            if not name.endswith(".depth"):
+                continue
+            channel = name[: -len(".depth")]
+            cap = prefixed.get(channel + ".capacity")
+            if not cap or cap < queue_sat_min_cap:
+                continue
+            if depth >= queue_sat_ratio * cap:
+                detail = {
+                    "depth": depth,
+                    "capacity": cap,
+                    "fill_ratio": round(depth / cap, 3),
+                    "threshold_ratio": queue_sat_ratio,
+                }
+                hw = prefixed.get(channel + ".high_water")
+                if hw is not None:
+                    detail["high_water"] = hw
+                out[channel] = detail
+        return out
+
+    def ingress_drops(ctx: HealthContext) -> Dict[str, dict]:
+        # Client-ingress overflow RATE, not the monotone total: a brief
+        # burst parked by the BatchMaker's pause/drain cycle is normal
+        # operation; a sustained overflow rate means offered load is
+        # past the admission plane's capacity.
+        rate = ctx.rate("worker.ingress_overflow", ingress_drop_window)
+        if rate is not None and rate > ingress_drop_rate:
+            return {
+                "": {
+                    "overflows_per_s": round(rate, 2),
+                    "threshold": ingress_drop_rate,
+                    "window_s": ingress_drop_window,
+                }
+            }
+        return {}
+
     return [
         HealthRule("commit_lag", commit_lag, for_intervals=2),
         HealthRule(
@@ -1213,6 +1284,20 @@ def default_rules(env: Optional[Mapping[str, str]] = None) -> List[HealthRule]:
         # oversized batch frame is already proof of hostile traffic.
         HealthRule("helper_abuse", helper_abuse),
         HealthRule("garbage_batches", garbage_batches),
+        # Hysteresis (default 3 intervals): a channel legitimately
+        # brushes its capacity during a burst-drain cycle; only a queue
+        # that STAYS at the ceiling across evaluations is saturated.
+        HealthRule(
+            "queue_saturated",
+            queue_saturated,
+            for_intervals=max(1, int(queue_sat_intervals)),
+        ),
+        HealthRule(
+            "ingress_drops",
+            ingress_drops,
+            for_intervals=2,
+            series=("worker.ingress_overflow",),
+        ),
     ]
 
 
@@ -1492,6 +1577,108 @@ def flight_event(kind: str, **fields) -> None:
     """Module-level convenience for the instrumented layers (one ring
     append; no-op when the registry is stubbed)."""
     _REGISTRY.flight.record(kind, **fields)
+
+
+# -- instrumented channels ----------------------------------------------------
+
+class InstrumentedQueue(asyncio.Queue):
+    """Drop-in ``asyncio.Queue`` emitting per-channel backpressure series.
+
+    Every inter-task channel in the node is constructed through this
+    class with a stable ``channel`` name, so a saturation knee reads as
+    a NAMED filling queue instead of an anonymous latency cliff.  All
+    series live under ``queue.<channel>.``:
+
+        depth       gauge     live qsize, written on every put/get (a
+                              plain gauge, not a callback, so the health
+                              monitor's plain-gauge scan and the scraped
+                              sample timeline both see it)
+        capacity    gauge     maxsize (0 = unbounded), set once
+        high_water  gauge     maximum depth ever observed
+        enqueued    counter   items accepted
+        dequeued    counter   items removed
+        full        counter   ``asyncio.QueueFull`` raised from
+                              ``put_nowait`` (the drop/park signal — the
+                              caller decides which; BatchMaker parks)
+        put_wait_seconds   histogram  time a blocking ``put()`` spent
+                                      suspended on a full queue (only
+                                      blocked puts are observed, so the
+                                      count is "puts that waited")
+        residence_seconds  histogram  enqueue→dequeue age per item
+
+    Cost: the enabled arm pays two counter increments, two gauge writes
+    and one timestamp-deque append/popleft per item — ``time.monotonic``
+    is called once on each side.  With ``NARWHAL_METRICS=0`` the
+    constructor registers nothing and every override reduces to one
+    attribute test before delegating, so the queue behaves like a plain
+    ``asyncio.Queue`` (the measured A/B arm; artifact
+    ``artifacts/queue_overhead_r21.json``).
+
+    Interception points are asyncio.Queue's internal ``_put``/``_get``
+    hooks: both the awaiting and the ``*_nowait`` paths funnel through
+    them, so accounting cannot miss an item or double-count one.
+    """
+
+    def __init__(self, maxsize: int = 0, *, channel: str) -> None:
+        self.channel = channel
+        reg = _REGISTRY
+        self._instrumented = reg.enabled
+        if self._instrumented:
+            self._m_depth = reg.gauge(f"queue.{channel}.depth")
+            self._m_capacity = reg.gauge(f"queue.{channel}.capacity")
+            self._m_high = reg.gauge(f"queue.{channel}.high_water")
+            self._m_enqueued = reg.counter(f"queue.{channel}.enqueued")
+            self._m_dequeued = reg.counter(f"queue.{channel}.dequeued")
+            self._m_full = reg.counter(f"queue.{channel}.full")
+            self._m_put_wait = reg.histogram(
+                f"queue.{channel}.put_wait_seconds"
+            )
+            self._m_residence = reg.histogram(
+                f"queue.{channel}.residence_seconds"
+            )
+            self._m_capacity.set(float(maxsize))
+            # Enqueue timestamps in FIFO order.  asyncio.Queue IS FIFO,
+            # so popleft pairs each dequeue with its enqueue exactly.
+            self._enq_ts: Deque[float] = collections.deque()
+        super().__init__(maxsize)
+
+    def _put(self, item) -> None:
+        super()._put(item)
+        if self._instrumented:
+            self._m_enqueued.inc()
+            self._enq_ts.append(time.monotonic())
+            depth = self.qsize()
+            self._m_depth.set(float(depth))
+            if depth > self._m_high.value:
+                self._m_high.set(float(depth))
+
+    def _get(self):
+        item = super()._get()
+        if self._instrumented:
+            self._m_dequeued.inc()
+            self._m_depth.set(float(self.qsize()))
+            if self._enq_ts:
+                self._m_residence.observe(
+                    time.monotonic() - self._enq_ts.popleft()
+                )
+        return item
+
+    async def put(self, item) -> None:
+        if not self._instrumented or not self.full():
+            # Fast path: one branch over a plain Queue — no clock call.
+            await super().put(item)
+            return
+        start = time.monotonic()
+        await super().put(item)
+        self._m_put_wait.observe(time.monotonic() - start)
+
+    def put_nowait(self, item) -> None:
+        try:
+            super().put_nowait(item)
+        except asyncio.QueueFull:
+            if self._instrumented:
+                self._m_full.inc()
+            raise
 
 
 # -- snapshot writer ----------------------------------------------------------

@@ -53,7 +53,13 @@ _FLUSH_MAX_BYTES = 1 << 20
 class _Peer:
     def __init__(self, address: str) -> None:
         self.address = address
-        self.queue: asyncio.Queue = asyncio.Queue(maxsize=_QUEUE_CAP)
+        # One shared channel name for every peer: the depth gauge is
+        # last-writer-wins across instances but high-water is monotone
+        # and the counters aggregate — the same committee-aggregated
+        # convention as the sim registry.
+        self.queue: asyncio.Queue = metrics.InstrumentedQueue(
+            _QUEUE_CAP, channel="net.simple_sender"
+        )
         self.task = spawn(self._run(), name="simple-sender-peer")
 
     async def _run(self) -> None:

@@ -13,8 +13,9 @@ import asyncio
 import logging
 from typing import Callable, List, Optional
 
+from .. import metrics
 from ..config import Committee, Parameters, WorkerId
-from ..utils.env import env_str
+from ..utils.env import env_int, env_str
 from ..utils.tasks import spawn
 from ..consensus import Consensus
 from ..crypto import KeyPair
@@ -124,10 +125,21 @@ async def spawn_primary_node(
         backend.warmup(max_claims=derive_max_claims(committee))
         log.info("Verify backend %s ready", backend.name)
 
-    cap = CHANNEL_CAPACITY if channel_capacity is None else channel_capacity
-    tx_new_certificates = asyncio.Queue(maxsize=CHANNEL_CAPACITY)
-    tx_feedback = asyncio.Queue(maxsize=cap)
-    tx_output = asyncio.Queue(maxsize=cap)
+    # One capacity for all three channels: the env knob (declared
+    # NARWHAL_CHANNEL_CAPACITY, sweepable by the knee matrix) unless the
+    # harness passed an explicit override.  Before the knob existed,
+    # tx_new_certificates silently ignored ``channel_capacity`` by
+    # reading the module constant instead of ``cap``.
+    cap = (
+        env_int("NARWHAL_CHANNEL_CAPACITY", CHANNEL_CAPACITY)
+        if channel_capacity is None
+        else channel_capacity
+    )
+    tx_new_certificates = metrics.InstrumentedQueue(
+        cap, channel="node.tx_new_certificates"
+    )
+    tx_feedback = metrics.InstrumentedQueue(cap, channel="node.tx_feedback")
+    tx_output = metrics.InstrumentedQueue(cap, channel="node.tx_output")
 
     # Same for the consensus kernel: compile its one static window shape
     # before the primary joins the committee (KernelTusk.prewarm docstring),

@@ -159,7 +159,10 @@ class Core:
         # when the pipeline is behind, so rx_primaries (and through it
         # the network receiver) keeps its backpressure.
         self._verify_q: Optional[asyncio.Queue] = (
-            asyncio.Queue(maxsize=max(256, 2 * self.verify_batch_max))
+            metrics.InstrumentedQueue(
+                max(256, 2 * self.verify_batch_max),
+                channel="primary.verify_window",
+            )
             if self.verify_window_s > 0
             else None
         )

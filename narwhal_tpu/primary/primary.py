@@ -24,6 +24,7 @@ from ..messages import (
 )
 from ..network import Receiver, Writer
 from ..store import Store
+from ..utils.env import env_int
 from ..utils.tasks import spawn
 from .certificate_waiter import CertificateWaiter
 from .core import AtomicRound, Core
@@ -114,17 +115,18 @@ class Primary:
         # Wire v2 key-index space: the committee roster, installed before
         # any codec runs (store replay, receivers, proposer).
         set_wire_committee(committee)
-        q = lambda: asyncio.Queue(maxsize=CHANNEL_CAPACITY)  # noqa: E731
+        cap = env_int("NARWHAL_CHANNEL_CAPACITY", CHANNEL_CAPACITY)
+        q = lambda ch: metrics.InstrumentedQueue(cap, channel=ch)  # noqa: E731
 
-        tx_primaries = q()  # network → core
-        tx_helper = q()
-        rx_our_digests = q()  # workers → proposer
-        rx_others_digests = q()  # workers → payload receiver
-        tx_headers_sync = q()  # synchronizer → header waiter
-        tx_certs_sync = q()  # synchronizer → certificate waiter
-        tx_headers_loopback = q()  # header waiter → core
-        tx_certs_loopback = q()  # certificate waiter → core
-        tx_own_headers = q()  # proposer → core
+        tx_primaries = q("primary.primaries")  # network → core
+        tx_helper = q("primary.helper")
+        rx_our_digests = q("primary.our_digests")  # workers → proposer
+        rx_others_digests = q("primary.others_digests")  # workers → payload receiver
+        tx_headers_sync = q("primary.headers_sync")  # synchronizer → header waiter
+        tx_certs_sync = q("primary.certs_sync")  # synchronizer → certificate waiter
+        tx_headers_loopback = q("primary.header_waiter")  # header waiter → core
+        tx_certs_loopback = q("primary.cert_waiter")  # certificate waiter → core
+        tx_own_headers = q("primary.own_headers")  # proposer → core
         # NOTE: no core → proposer queue anymore — parents are delivered
         # via Proposer.deliver_parents, a synchronous same-loop callback
         # (skips the queue round-trip on the round-cadence critical path).

@@ -191,9 +191,12 @@ def _tx(counter: int, size: int) -> bytes:
 
 def deterministic_blob(artifact: dict) -> bytes:
     """The bit-reproducibility surface of a sim artifact: everything
-    except the wall-clock section, canonically serialized.  Two runs of
-    the same (seed, spec) must produce byte-identical blobs."""
-    core = {k: v for k, v in artifact.items() if k != "wall"}
+    except the wall-clock sections, canonically serialized.  Two runs of
+    the same (seed, spec) must produce byte-identical blobs.  ``queues``
+    is excluded with ``wall``: its put-wait/residence histograms measure
+    host time (time.monotonic), not virtual time — the counts are
+    schedule-determined but the means are wall noise."""
+    core = {k: v for k, v in artifact.items() if k not in ("wall", "queues")}
     return json.dumps(core, sort_keys=True, separators=(",", ":")).encode()
 
 
@@ -206,6 +209,7 @@ def run_sim_scenario(
     rate_cap: int = _RATE_CAP,
     max_virtual_s: Optional[float] = None,
     commit_rule: Optional[str] = None,
+    large_n_rate_cap: Optional[int] = 60,
 ) -> dict:
     """Run one scenario arm in simulation; returns the artifact dict
     (see module docstring).  ``consensus_cls_by_node`` swaps a node's
@@ -213,7 +217,10 @@ def run_sim_scenario(
     selects the consensus commit rule for the WHOLE committee (the
     flag-flip sweep's arm knob); each node's audit segment records it,
     so the safety replay judges against the matching frozen oracle with
-    no further plumbing."""
+    no further plumbing.  ``large_n_rate_cap`` is the extra offered-load
+    clamp applied above 10 nodes (wall cost of the sim is linear in
+    frames); the knee matrix passes ``None`` to sweep real rates at
+    N=10/20."""
     import os
     import shutil
 
@@ -263,8 +270,8 @@ def run_sim_scenario(
     # schedule/fault diversity, wall cost is linear in frames, and the
     # batch plane broadcasts every seal to N-1 peers.
     rate = min(scenario.rate, rate_cap)
-    if scenario.nodes > 10:
-        rate = min(rate, 60)
+    if scenario.nodes > 10 and large_n_rate_cap is not None:
+        rate = min(rate, large_n_rate_cap)
     audit_segments: Dict[int, List[str]] = {}
     commits: Dict[int, List] = {i: [] for i in range(scenario.nodes)}
     monitor_events: List[dict] = []
@@ -630,6 +637,32 @@ def run_sim_scenario(
             else None
         ),
     }
+    # Same virtual clock, consensus side: per-leader first→quorum-th
+    # direct-support arrival spread (ms) — the multi-leader flip's
+    # before-number at N=10/20 with zero host noise.
+    sa = reg.histograms.get("consensus.support_arrival_ms")
+    support_arrival = {
+        "count": sa.count if sa is not None else 0,
+        "mean_virtual_ms": (
+            round(sa.sum / sa.count, 3)
+            if sa is not None and sa.count
+            else None
+        ),
+    }
+    # Per-channel backpressure accounting over the shared registry: the
+    # sim runs the whole committee in one process, so channel series
+    # aggregate committee-wide (same convention as the queue-depth
+    # gauge_fns).  No scrape timeline here — first_saturating uses the
+    # high-water fallback.  The join lives in the bench package; a
+    # deployment that ships only narwhal_tpu simply omits the section.
+    try:
+        from benchmark.metrics_check import queue_pressure_summary
+    except ImportError:
+        queues = {}
+    else:
+        queues = queue_pressure_summary(
+            [reg.snapshot(include_trace=False)]
+        )
 
     artifact = {
         "name": scenario.name,
@@ -641,6 +674,8 @@ def run_sim_scenario(
         "sim_rate": rate,
         "commit_rule": _effective_rule(commit_rule),
         "cert_to_commit": cert_to_commit,
+        "support_arrival": support_arrival,
+        "queues": queues,
         "parameters": params.to_json(),
         "verdicts": {
             "safety": safety,

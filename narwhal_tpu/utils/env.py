@@ -122,6 +122,14 @@ _VARS = [
         "fail the safety replay; checkpoints refuse a cross-rule "
         "restore.",
     ),
+    EnvVar(
+        "NARWHAL_CHANNEL_CAPACITY", "int", 1_000,
+        "Bounded-queue capacity for every inter-task channel "
+        "(node/primary/worker planes; the quorum admission window keeps "
+        "its own QUORUM_WINDOW depth). The knee matrix sweeps it; "
+        "in-process harnesses may still pass an explicit per-node "
+        "override.",
+    ),
     # -- observability --------------------------------------------------------
     EnvVar(
         "NARWHAL_METRICS", "flag", True,
@@ -260,6 +268,31 @@ _VARS = [
         "NARWHAL_HEALTH_SYNC_AGE_S", "float", 8,
         "`batch_withholding` fires when a requested-but-unserved batch "
         "ages past this (above the stock 5 s sync retry delay).",
+    ),
+    EnvVar(
+        "NARWHAL_HEALTH_QUEUE_SAT_RATIO", "float", 0.9,
+        "`queue_saturated` fires when an instrumented channel's depth "
+        "reaches this fraction of its capacity.",
+    ),
+    EnvVar(
+        "NARWHAL_HEALTH_QUEUE_SAT_MIN_CAP", "float", 16,
+        "`queue_saturated` ignores channels with capacity below this: "
+        "the quorum admission window and the sim's depth-1 channels run "
+        "full as their backpressure mechanism.",
+    ),
+    EnvVar(
+        "NARWHAL_HEALTH_QUEUE_SAT_INTERVALS", "float", 3,
+        "`queue_saturated` hysteresis: consecutive over-threshold "
+        "evaluations before the rule fires.",
+    ),
+    EnvVar(
+        "NARWHAL_HEALTH_INGRESS_DROP_RATE", "float", 1.0,
+        "`ingress_drops` fires above this many client-ingress "
+        "overflows/s (`worker.ingress_overflow` rate).",
+    ),
+    EnvVar(
+        "NARWHAL_HEALTH_INGRESS_DROP_WINDOW_S", "float", 5,
+        "`ingress_drops` rate window in seconds.",
     ),
     # -- crypto backend (ROADMAP item 1) --------------------------------------
     EnvVar(

@@ -28,7 +28,7 @@ from ..messages import (
 )
 from ..network import Receiver, Writer
 from ..store import Store
-from ..utils.env import env_flag, positive_int
+from ..utils.env import env_flag, env_int, positive_int
 from ..utils.tasks import spawn
 from .batch_maker import BatchMaker
 from .helper import Helper, max_request_digests
@@ -211,7 +211,8 @@ class Worker:
         loop = asyncio.get_running_loop()
         # Wire v2 key-index space (see Primary.spawn).
         set_wire_committee(committee)
-        q = lambda: asyncio.Queue(maxsize=CHANNEL_CAPACITY)  # noqa: E731
+        cap = env_int("NARWHAL_CHANNEL_CAPACITY", CHANNEL_CAPACITY)
+        q = lambda ch: metrics.InstrumentedQueue(cap, channel=ch)  # noqa: E731
 
         # Byzantine wiring mirrors primary.py: same channels, same
         # pipelines — the adversary acts only at the network boundary.
@@ -232,12 +233,17 @@ class Worker:
                     fault_plan, name, worker_id, committee, store
                 )
 
-        to_quorum = asyncio.Queue(maxsize=QUORUM_WINDOW)
-        own_batches = q()
-        others_batches = q()
-        to_primary = q()
-        helper_queue = q()
-        sync_queue = q()
+        # to_quorum keeps its QUORUM_WINDOW depth: its fullness IS the
+        # admission backpressure (below queue_saturated's MIN_CAP floor
+        # on purpose — running full there is mechanism, not anomaly).
+        to_quorum = metrics.InstrumentedQueue(
+            QUORUM_WINDOW, channel="worker.to_quorum"
+        )
+        own_batches = q("worker.own_batches")
+        others_batches = q("worker.others_batches")
+        to_primary = q("worker.to_primary")
+        helper_queue = q("worker.helper")
+        sync_queue = q("worker.sync")
 
         # Queue-depth gauges: callbacks polled only at snapshot/scrape
         # time, so the hot path pays nothing.  These are exactly the

@@ -580,3 +580,99 @@ def test_stale_replay_default_rides_out_heal_burst_but_fires_on_flood():
         firing = mon.evaluate(t + i)
         fired = fired or "stale_replay" in {f["rule"] for f in firing}
     assert fired
+
+
+# -- queue backpressure rules (ISSUE 17) --------------------------------------
+
+def test_queue_saturated_names_channel_with_hysteresis():
+    """A wide channel sitting at >= 90% of capacity for three intervals
+    fires queue_saturated with the channel as subject; a single deep
+    sample (one interval) must not — transient bursts are what bounded
+    queues are FOR."""
+    reg = Registry()
+    depth = reg.gauge("queue.primary.others_digests.depth")
+    reg.gauge("queue.primary.others_digests.capacity").set(1000)
+    reg.gauge("queue.primary.others_digests.high_water").set(960)
+    mon = HealthMonitor(reg, rules=default_rules({}), interval_s=1.0)
+    t = 100.0
+    depth.set(500)
+    assert mon.evaluate(t) == []
+    # Deep for one interval, then drained: no firing (for_intervals=3).
+    depth.set(950)
+    assert mon.evaluate(t + 1) == []
+    depth.set(10)
+    assert mon.evaluate(t + 2) == []
+    # Deep for three consecutive intervals: fires, naming the channel.
+    depth.set(950)
+    assert mon.evaluate(t + 3) == []
+    assert mon.evaluate(t + 4) == []
+    firing = mon.evaluate(t + 5)
+    assert [f["rule"] for f in firing] == ["queue_saturated"]
+    assert firing[0]["subject"] == "primary.others_digests"
+    assert firing[0]["detail"]["fill_ratio"] == 0.95
+    assert firing[0]["detail"]["high_water"] == 960.0
+    # Draining clears it (clear_intervals default).
+    depth.set(10)
+    mon.evaluate(t + 6)
+    assert mon.evaluate(t + 7) == []
+
+
+def test_queue_saturated_skips_narrow_pipeline_windows():
+    """Channels below the min-capacity floor — worker.to_quorum's
+    QUORUM_WINDOW=8, the sim's tiny handoffs — run full BY DESIGN under
+    steady load and must never alert; lowering the floor via env brings
+    them back in scope."""
+    reg = Registry()
+    depth = reg.gauge("queue.worker.to_quorum.depth")
+    reg.gauge("queue.worker.to_quorum.capacity").set(8)
+    depth.set(8)  # pegged, by design
+    mon = HealthMonitor(reg, rules=default_rules({}), interval_s=1.0)
+    for i in range(6):
+        assert mon.evaluate(200.0 + i) == [], "narrow window alerted"
+    # Floor lowered: the same gauges now fire after the hysteresis run.
+    mon2 = HealthMonitor(
+        reg,
+        rules=default_rules({"NARWHAL_HEALTH_QUEUE_SAT_MIN_CAP": "8"}),
+        interval_s=1.0,
+    )
+    fired = []
+    for i in range(6):
+        fired = mon2.evaluate(300.0 + i) or fired
+    assert [f["rule"] for f in fired] == ["queue_saturated"]
+    assert fired[0]["subject"] == "worker.to_quorum"
+
+
+def test_ingress_drops_fires_on_sustained_rate_not_burst():
+    """ingress_drops judges the overflow RATE over its window: a
+    sustained client-ingress overflow (offered load past the admission
+    plane) fires; zero overflow never does; and draining the overflow
+    stream clears the rule."""
+    reg = Registry()
+    c = reg.counter("worker.ingress_overflow")
+    mon = HealthMonitor(
+        reg,
+        rules=default_rules({"NARWHAL_HEALTH_INGRESS_DROP_WINDOW_S": "5"}),
+        interval_s=1.0,
+    )
+    t = 400.0
+    assert mon.evaluate(t) == []
+    # Sustained 10 overflows/s: the rule fires once the window is
+    # spanned plus its for_intervals=2 hysteresis.
+    fired_at = None
+    for i in range(1, 12):
+        c.inc(10)
+        firing = mon.evaluate(t + i)
+        if "ingress_drops" in {f["rule"] for f in firing}:
+            fired_at = i
+            detail = [f for f in firing if f["rule"] == "ingress_drops"][0]
+            assert detail["detail"]["overflows_per_s"] > 1.0
+            break
+    assert fired_at is not None, "sustained overflow never fired"
+    assert fired_at >= 5, "fired before the rate window was spanned"
+    # Overflow stops: the burst slides out of the window and it clears.
+    cleared = None
+    for i in range(fired_at + 1, fired_at + 15):
+        if mon.evaluate(t + i) == []:
+            cleared = i
+            break
+    assert cleared is not None, "never cleared after overflow stopped"

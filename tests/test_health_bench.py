@@ -211,6 +211,32 @@ def test_clean_local_bench_has_timeline_and_no_firing_rules(tmp_path):
     assert abs(check["votes"]["ratio"] - 1.0) <= 0.05, check
     assert abs(check["certificates"]["ratio"] - 1.0) <= 0.05, check
 
+    # -- queue & backpressure accounting (ISSUE 17 tentpole) -----------------
+    # All 8 processes (4 primaries + 4 workers) must publish their
+    # per-channel InstrumentedQueue tables into the bench JSON's queues
+    # section, and the committee-wide aggregate must carry the load-
+    # bearing channels with sane capacities.  A clean run at this rate
+    # must not have dropped anything into a full queue on the wide
+    # 1000-capacity channels.
+    queues = result.queues
+    assert len(queues["nodes"]) == 8, sorted(queues["nodes"])
+    for pid, channels in queues["nodes"].items():
+        assert channels, pid
+    agg = queues["channels"]
+    for ch in (
+        "node.tx_output",
+        "primary.others_digests",
+        "worker.to_primary",
+        "worker.to_quorum",
+    ):
+        assert ch in agg, sorted(agg)
+        assert agg[ch]["enqueued"] > 0, (ch, agg[ch])
+    assert agg["worker.to_quorum"]["capacity"] == 8  # QUORUM_WINDOW
+    assert agg["node.tx_output"]["capacity"] >= 16
+    for ch, a in agg.items():
+        if a["capacity"] >= 16:
+            assert a["full"] == 0, (ch, a)
+
     # -- flight recorder at quiesce (ISSUE 11 satellite) ---------------------
     # Every node's /debug/flight ring rides in the bench JSON, so even a
     # clean run carries its last-seconds event history.  Primaries must

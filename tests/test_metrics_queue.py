@@ -1,0 +1,141 @@
+"""Unit tests for metrics.InstrumentedQueue (ISSUE 17): the per-channel
+backpressure accounting every inter-task channel is built from.  Covers
+the counter/gauge bookkeeping through both the awaiting and *_nowait
+paths, blocked-put wait observation, FIFO residence pairing, QueueFull
+accounting, the NARWHAL_METRICS=0 no-op arm, and depth/high-water under
+concurrent producers."""
+
+import asyncio
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from narwhal_tpu import metrics  # noqa: E402
+from narwhal_tpu.metrics import InstrumentedQueue, Registry  # noqa: E402
+
+
+@pytest.fixture
+def reg(monkeypatch):
+    """A fresh enabled registry swapped in for the module global, so each
+    test sees only its own queue.* instruments."""
+    fresh = Registry(enabled=True)
+    monkeypatch.setattr(metrics, "_REGISTRY", fresh)
+    return fresh
+
+
+def test_basic_accounting_both_paths(reg):
+    async def go():
+        q = InstrumentedQueue(4, channel="t.chan")
+        await q.put("a")       # awaiting path
+        q.put_nowait("b")      # nowait path
+        await q.put("c")
+        assert reg.gauges["queue.t.chan.capacity"].value == 4.0
+        assert reg.gauges["queue.t.chan.depth"].value == 3.0
+        assert reg.gauges["queue.t.chan.high_water"].value == 3.0
+        assert reg.counters["queue.t.chan.enqueued"].value == 3
+        assert q.get_nowait() == "a"   # FIFO preserved
+        assert await q.get() == "b"
+        assert reg.counters["queue.t.chan.dequeued"].value == 2
+        assert reg.gauges["queue.t.chan.depth"].value == 1.0
+        # High-water is monotone: draining must not lower it.
+        assert reg.gauges["queue.t.chan.high_water"].value == 3.0
+        # Residence observed once per dequeued item.
+        res = reg.histograms["queue.t.chan.residence_seconds"]
+        assert res.count == 2
+
+    asyncio.run(go())
+
+
+def test_put_wait_observed_only_when_blocked(reg):
+    async def go():
+        q = InstrumentedQueue(1, channel="t.block")
+        await q.put(1)  # fits: must NOT be observed as a wait
+        assert reg.histograms["queue.t.block.put_wait_seconds"].count == 0
+
+        async def consume_later():
+            await asyncio.sleep(0.05)
+            return await q.get()
+
+        consumer = asyncio.ensure_future(consume_later())
+        await q.put(2)  # queue full: blocks until the consumer drains
+        await consumer
+        pw = reg.histograms["queue.t.block.put_wait_seconds"]
+        assert pw.count == 1
+        assert pw.sum >= 0.04
+
+    asyncio.run(go())
+
+
+def test_queuefull_counted_and_reraised(reg):
+    async def go():
+        q = InstrumentedQueue(2, channel="t.full")
+        q.put_nowait(1)
+        q.put_nowait(2)
+        with pytest.raises(asyncio.QueueFull):
+            q.put_nowait(3)
+        with pytest.raises(asyncio.QueueFull):
+            q.put_nowait(4)
+        assert reg.counters["queue.t.full.full"].value == 2
+        # Rejected items never count as enqueued.
+        assert reg.counters["queue.t.full.enqueued"].value == 2
+
+    asyncio.run(go())
+
+
+def test_disabled_registry_arm_is_plain_queue(monkeypatch):
+    """With NARWHAL_METRICS=0 the constructor registers nothing and the
+    queue behaves exactly like asyncio.Queue — the stubbed arm of the
+    overhead A/B."""
+    stub = Registry(enabled=False)
+    monkeypatch.setattr(metrics, "_REGISTRY", stub)
+
+    async def go():
+        q = InstrumentedQueue(2, channel="t.noop")
+        await q.put("a")
+        q.put_nowait("b")
+        with pytest.raises(asyncio.QueueFull):
+            q.put_nowait("c")
+        assert await q.get() == "a"
+        assert q.get_nowait() == "b"
+        assert q.empty()
+        snap = stub.snapshot()
+        assert snap["gauges"] == {}
+        assert snap["counters"] == {}
+        assert snap["histograms"] == {}
+
+    asyncio.run(go())
+
+
+def test_concurrent_producers_depth_and_high_water(reg):
+    """Eight producers against a capacity-4 queue and one slow consumer:
+    high-water pegs at capacity, totals balance, and the final depth
+    gauge reads empty."""
+    total = 24
+
+    async def go():
+        q = InstrumentedQueue(4, channel="t.conc")
+
+        async def producer(k):
+            for i in range(total // 8):
+                await q.put((k, i))
+
+        async def consumer():
+            for _ in range(total):
+                await q.get()
+                await asyncio.sleep(0.001)
+
+        await asyncio.gather(
+            consumer(), *(producer(k) for k in range(8))
+        )
+        assert reg.counters["queue.t.conc.enqueued"].value == total
+        assert reg.counters["queue.t.conc.dequeued"].value == total
+        assert reg.gauges["queue.t.conc.depth"].value == 0.0
+        assert reg.gauges["queue.t.conc.high_water"].value == 4.0
+        assert reg.histograms["queue.t.conc.residence_seconds"].count == total
+        # Producers outnumber capacity: blocked puts were observed.
+        assert reg.histograms["queue.t.conc.put_wait_seconds"].count > 0
+
+    asyncio.run(go())

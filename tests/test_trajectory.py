@@ -231,3 +231,48 @@ def test_repo_committed_artifacts_pass_with_r05_waived():
     )
     tps = next(r for r in r05 if r["metric"] == "end_to_end_tps")
     assert tps["baseline_revision"] == "r02"
+
+
+def test_knee_matrix_artifact_flattens_to_attr_namespace(tmp_path):
+    """A benchmark/knee_matrix artifact loads as knee.n<N>.* metrics —
+    attribution-namespaced via its artifacts/ placement, never gated —
+    and a matrix with no located knees is skipped with a reason."""
+    root = str(tmp_path)
+    write(
+        f"{root}/artifacts/knee_matrix_r21.json",
+        {
+            "generated_by": "benchmark/knee_matrix",
+            "configs": [
+                {
+                    "n": 4,
+                    "mode": "socketed",
+                    "points": [],
+                    "knee": {
+                        "rate": 20_000,
+                        "tps": 11_000.0,
+                        "latency_ms": 1_900.0,
+                        "first_saturating": {
+                            "channel": "worker.to_quorum",
+                        },
+                    },
+                },
+                {"n": 10, "mode": "sim", "points": [], "knee": {}},
+            ],
+        },
+    )
+    revisions, _ = trajectory.collect(root)
+    m = revisions["r21"]["metrics"]
+    assert m["attr.knee.n4.rate"] == 20_000
+    assert m["attr.knee.n4.tps"] == 11_000.0
+    assert m["attr.knee.n4.latency_ms"] == 1_900.0
+    assert not any(k.startswith("attr.knee.n10.") for k in m)
+
+    write(
+        f"{root}/artifacts/knee_matrix_r22.json",
+        {"generated_by": "benchmark/knee_matrix", "configs": []},
+    )
+    _, skipped = trajectory.collect(root)
+    reasons = {s["file"]: s["reason"] for s in skipped}
+    assert "without located knees" in reasons[
+        os.path.join("artifacts", "knee_matrix_r22.json")
+    ]
