@@ -64,10 +64,13 @@ metrics-smoke: native
 
 # Committee flight-recorder + trace-export smoke (ISSUE 11): drive the
 # health-bench clean run (4-node local_bench with --trace-out) and drop
-# the exported Perfetto trace, the quiesce flight rings, and the scraped
-# timeline into .ci-artifacts/ for the workflow upload.  The test itself
-# round-trips the trace (8 process rows, ≥1 cross-process digest flow,
-# sampled-CPU track) and asserts every node's flight ring is populated.
+# the exported Perfetto trace, the quiesce flight rings, the scraped
+# timeline, and the critical-path/straggler/clock artifact into
+# .ci-artifacts/ for the workflow upload.  The test itself round-trips
+# the trace (8 process rows, ≥1 cross-process digest flow, sampled-CPU
+# track, committee critical-path row), asserts every node's flight ring
+# is populated, and gates a non-empty critical_path whose per-leg sums
+# telescope to the e2e span within 10%.
 trace-smoke:
 	JAX_PLATFORMS=cpu NARWHAL_METRICS_DUMP=.ci-artifacts \
 		$(PYTHON) -m pytest tests/test_health_bench.py -x -q

@@ -202,6 +202,17 @@ def main() -> None:
                 "stages_ms": result.stages_ms,
                 "metrics_committed_tx": round(result.metrics_committed_tx, 1),
                 "metrics_disagreement": result.metrics_disagreement,
+                # Support-quorum spread headline (gated in
+                # benchmark/trajectory.py like cert_to_commit_ms) plus
+                # the slowest causal chain and who-closed-the-quorum
+                # table of the median run.
+                "support_arrival_ms": (
+                    result.stragglers.get("gaps", {})
+                    .get("support_arrival_ms", {})
+                    .get("mean")
+                ),
+                "critical_path": result.critical_path,
+                "stragglers": result.stragglers,
                 # Wire-goodput & crypto-cost headline (median run): the
                 # cross-revision numbers benchmark/trajectory.py tracks.
                 "goodput_ratio": result.wire.get("goodput_ratio"),

@@ -519,7 +519,13 @@ def run_bench(
     # fatal error (every caller treats result.errors as run failure).
     if metrics_on:
         snapshots = load_snapshots(metrics_paths, result.errors)
-        cross_validate(result, snapshots, tx_size)
+        mc = cross_validate(result, snapshots, tx_size)
+        # Clock model + causal attribution sections: the reconciled
+        # per-node corrections the stage join applied, the slowest
+        # end-to-end chain(s), and the ranked quorum-straggler table.
+        result.clock = mc.get("clock", {})
+        result.critical_path = mc.get("critical_path", {})
+        result.stragglers = mc.get("stragglers", {})
         # Wire-goodput + crypto-cost ledger sections (the `wire` and
         # `crypto` keys of the bench JSON).
         result.runtime = loop_stall_summary(snapshots)
@@ -703,6 +709,16 @@ def main():
                     # Per-channel queue backpressure accounting + the
                     # first-saturating attribution (knee matrix input).
                     "queues": result.queues,
+                    # Clock model: per-node reconciled corrections (from
+                    # the ACK-piggybacked offset estimator) applied to
+                    # the cross-node stage join above.
+                    "clock": result.clock,
+                    # Slowest end-to-end causal chain(s): per-leg ms,
+                    # telescoping to the e2e span.
+                    "critical_path": result.critical_path,
+                    # Ranked who-closed-the-quorum attribution + gap
+                    # histogram means.
+                    "stragglers": result.stragglers,
                 }
             )
         )
@@ -716,6 +732,23 @@ def main():
             print(" + ROUND CADENCE (mean ms per sub-leg):")
             for name, ms in result.round_stages_ms.items():
                 print(f"   {name}: {ms:,.2f} ms")
+        path = result.critical_path.get("path")
+        if path:
+            print(
+                " + CRITICAL PATH (slowest committed digest, "
+                f"{path['e2e_ms']:,.1f} ms e2e):"
+            )
+            for name, ms in path["legs_ms"].items():
+                print(f"   {name}: {ms:,.1f} ms")
+        for family, label in (
+            ("vote_quorum", "vote quorum"),
+            ("support_quorum", "support quorum"),
+        ):
+            ranked = result.stragglers.get(family)
+            if ranked:
+                print(f" + QUORUM STRAGGLERS ({label}, most-charged first):")
+                for e in ranked:
+                    print(f"   {e['address']}: {e['count']:,}")
         if result.wire:
             totals = result.wire.get("totals", {})
             print(" + WIRE LEDGER:")

@@ -124,6 +124,13 @@ class ParseResult:
     # (metrics_check.queue_pressure_summary): per-node channel tables,
     # committee-wide aggregates, and the first-saturating attribution.
     queues: Dict = field(default_factory=dict)
+    # Wall-clock model sections (metrics_check): per-node reconciled
+    # clock corrections applied to the cross-node stage join, the
+    # slowest end-to-end causal chain(s) through the pipeline, and the
+    # ranked who-closed-the-quorum straggler attribution.
+    clock: Dict = field(default_factory=dict)
+    critical_path: Dict = field(default_factory=dict)
+    stragglers: Dict = field(default_factory=dict)
 
     def summary(self, rate: int, tx_size: int, nodes: int, workers: int) -> str:
         return (

@@ -12,8 +12,12 @@ error entry, which every harness treats as fatal).
 The same per-digest trace join also yields the per-stage pipeline latency
 breakdown (batch-sealed → quorum → digest-at-primary → header →
 certificate → commit): each process stamps wall-clock times for the
-stages it owns, and since the committee runs on one host the stamps join
-directly across process snapshots.
+stages it owns.  On one host the stamps join directly; across hosts (or
+a deliberately skewed harness) each node's stamps are first shifted by
+its reconciled clock correction — the zero-mean offset vector estimated
+from ReliableSender ACK round-trips (narwhal_tpu/network/clocksync.py)
+and carried in every snapshot's ``clock.offset_ms.*`` gauges — so the
+cross-node legs measure causality, not whose NTP daemon drifted.
 """
 
 from __future__ import annotations
@@ -27,6 +31,7 @@ from typing import Dict, List, Optional, Tuple
 # (a hand-copied tuple here would silently drop any future stage from
 # the breakdown).
 from narwhal_tpu.metrics import ROUND_STAGES, STAGES as STAGE_ORDER
+from narwhal_tpu.network import clocksync
 
 STAGE_LEGS: Tuple[Tuple[str, str], ...] = tuple(
     zip(STAGE_ORDER[:-1], STAGE_ORDER[1:])
@@ -89,6 +94,173 @@ def loop_stall_summary(snapshots: List[dict]) -> Dict[str, dict]:
     return out
 
 
+# -- clock-offset correction --------------------------------------------------
+
+_CLOCK_OFFSET_PREFIX = "clock.offset_ms."
+_CLOCK_UNC_PREFIX = "clock.offset_uncertainty_ms."
+
+
+def snapshot_correction_ms(snap: dict) -> float:
+    """One node's reconciled wall-clock correction, from its own
+    ``clock.offset_ms.*`` gauges.  Subtracting ``correction/1000`` from
+    the node's stamps places them on the committee's mean clock; 0.0
+    when the snapshot carries no offset gauges (pre-clocksync snapshot,
+    or a node that never completed an ACK round trip), which degrades to
+    the old uncorrected join rather than failing."""
+    gauges = snap.get("gauges") or {}
+    peers = {
+        name[len(_CLOCK_OFFSET_PREFIX):]: float(v)
+        for name, v in gauges.items()
+        if name.startswith(_CLOCK_OFFSET_PREFIX) and v is not None
+    }
+    if not peers:
+        return 0.0
+    return clocksync.reconcile_zero_mean({"self": peers})["self"]
+
+
+def clock_summary(snapshots: List[dict]) -> dict:
+    """Per-node clock section for the bench JSON: the raw per-peer
+    offset gauges, the reconciled correction the stage join applies, and
+    the worst per-peer uncertainty bound (RTT/2 of the best sample) —
+    the error bar on every cross-node leg below."""
+    nodes: Dict[str, dict] = {}
+    for snap in snapshots:
+        if not snap.get("enabled", True):
+            continue
+        gauges = snap.get("gauges") or {}
+        peers = {
+            name[len(_CLOCK_OFFSET_PREFIX):]: round(float(v), 3)
+            for name, v in gauges.items()
+            if name.startswith(_CLOCK_OFFSET_PREFIX) and v is not None
+        }
+        if not peers:
+            continue
+        unc = [
+            float(v)
+            for name, v in gauges.items()
+            if name.startswith(_CLOCK_UNC_PREFIX) and v is not None
+        ]
+        key = str(snap.get("pid") or snap.get("node") or len(nodes))
+        nodes[key] = {
+            "correction_ms": round(snapshot_correction_ms(snap), 3),
+            "peer_offsets_ms": dict(sorted(peers.items())),
+            "max_uncertainty_ms": round(max(unc), 3) if unc else None,
+        }
+    return nodes
+
+
+def corrected_stage_join(
+    snapshots: List[dict],
+) -> Tuple[Dict[str, Dict[str, float]], Dict[str, int]]:
+    """Join per-digest stage stamps across node snapshots, each node's
+    stamps shifted onto the committee mean clock by its reconciled
+    correction.  Earliest corrected timestamp wins per (digest, stage) —
+    the same convention the log parser uses across primaries.  Returns
+    (stage_ts, seal_bytes)."""
+    stage_ts: Dict[str, Dict[str, float]] = {}
+    seal_bytes: Dict[str, int] = {}
+    for snap in snapshots:
+        if not snap.get("enabled", True):
+            continue
+        corr_s = snapshot_correction_ms(snap) / 1000.0
+        for digest, entry in snap.get("trace", {}).items():
+            dst = stage_ts.setdefault(digest, {})
+            for stage in STAGE_ORDER:
+                t = entry.get(stage)
+                if t is None:
+                    continue
+                t = t - corr_s
+                if stage not in dst or t < dst[stage]:
+                    dst[stage] = t
+            b = entry.get("bytes")
+            if b:
+                seal_bytes.setdefault(digest, int(b))
+    return stage_ts, seal_bytes
+
+
+def critical_path_summary(
+    stage_ts: Dict[str, Dict[str, float]], top_k: int = 3
+) -> dict:
+    """The slowest end-to-end causal chain through the pipeline: among
+    digests carrying the full stage chain, the one with the largest
+    seal→commit span, decomposed into consecutive-stage legs.  The legs
+    TELESCOPE — their sum is exactly the e2e span by construction — so
+    ``legs_sum_ms`` vs ``e2e_ms`` is a self-check on the join, not new
+    information (the CI smoke gates on it anyway: a big gap means a
+    stage was dropped from STAGE_ORDER or stamped on a different clock).
+    ``slowest`` lists the top-k chains; ``path`` is the worst one."""
+    chains = []
+    for digest, st in stage_ts.items():
+        if all(s in st for s in STAGE_ORDER):
+            chains.append((st["commit"] - st["seal"], digest, st))
+    chains.sort(key=lambda c: -c[0])
+    out: dict = {"full_chains": len(chains)}
+    slowest = []
+    for e2e, digest, st in chains[:top_k]:
+        legs = {
+            f"{a}_to_{b}": round(1000 * (st[b] - st[a]), 3)
+            for a, b in STAGE_LEGS
+        }
+        slowest.append(
+            {
+                "digest": digest,
+                "e2e_ms": round(1000 * e2e, 3),
+                "legs_ms": legs,
+                "legs_sum_ms": round(sum(legs.values()), 3),
+            }
+        )
+    if slowest:
+        out["path"] = slowest[0]
+        out["slowest"] = slowest
+    return out
+
+
+# -- quorum-straggler attribution ---------------------------------------------
+
+_STRAGGLER_FAMILIES: Tuple[Tuple[str, str], ...] = (
+    ("vote_quorum", "primary.quorum_straggler."),
+    ("support_quorum", "consensus.support_straggler."),
+)
+
+_GAP_HISTOGRAMS: Tuple[Tuple[str, str], ...] = (
+    ("vote_quorum_gap_ms", "primary.vote_quorum_gap_ms"),
+    ("parent_quorum_gap_ms", "primary.parent_quorum_gap_ms"),
+    ("support_arrival_ms", "consensus.support_arrival_ms"),
+)
+
+
+def quorum_straggler_summary(snapshots: List[dict]) -> dict:
+    """Ranked who-closed-the-quorum table for the bench JSON: per
+    quorum family, the authorities (by primary address) charged with
+    arriving last when the quorum crossed, most-charged first, plus the
+    mean first-arrival→quorum gap histograms.  A consistently-top
+    authority is the committee's straggler — the node whose latency the
+    quorum waits out — which is attribution the aggregate histograms
+    alone cannot give."""
+    counters = _agg_counters(snapshots)
+    hists = _agg_histograms(snapshots)
+    out: dict = {}
+    for family, prefix in _STRAGGLER_FAMILIES:
+        ranked = sorted(
+            (
+                {"address": name[len(prefix):], "count": int(v)}
+                for name, v in counters.items()
+                if name.startswith(prefix) and v
+            ),
+            key=lambda e: (-e["count"], e["address"]),
+        )
+        if ranked:
+            out[family] = ranked
+    gaps: Dict[str, dict] = {}
+    for label, name in _GAP_HISTOGRAMS:
+        s, c = hists.get(name, (0.0, 0))
+        if c:
+            gaps[label] = {"count": int(c), "mean": round(s / c, 3)}
+    if gaps:
+        out["gaps"] = gaps
+    return out
+
+
 def cross_validate(
     result,
     snapshots: List[dict],
@@ -121,22 +293,10 @@ def cross_validate(
             file=sys.stderr,
         )
 
-    # Earliest timestamp per (digest, stage) across every snapshot —
-    # the same convention the log parser uses across primaries.
-    stage_ts: Dict[str, Dict[str, float]] = {}
-    seal_bytes: Dict[str, int] = {}
-    for snap in snapshots:
-        if not snap.get("enabled", True):
-            continue
-        for digest, entry in snap.get("trace", {}).items():
-            dst = stage_ts.setdefault(digest, {})
-            for stage in STAGE_ORDER:
-                t = entry.get(stage)
-                if t is not None and (stage not in dst or t < dst[stage]):
-                    dst[stage] = t
-            b = entry.get("bytes")
-            if b:
-                seal_bytes.setdefault(digest, int(b))
+    # Skew-corrected earliest timestamp per (digest, stage) across every
+    # snapshot — each node's stamps shifted by its reconciled offset
+    # before the min-join (see corrected_stage_join).
+    stage_ts, seal_bytes = corrected_stage_join(snapshots)
 
     committed = [d for d, st in stage_ts.items() if "commit" in st]
     metrics_bytes = sum(seal_bytes.get(d, 0) for d in committed)
@@ -212,6 +372,9 @@ def cross_validate(
             round(disagreement, 4) if disagreement is not None else None
         ),
         "round_attribution": round_attr,
+        "clock": clock_summary(snapshots),
+        "critical_path": critical_path_summary(stage_ts),
+        "stragglers": quorum_straggler_summary(snapshots),
     }
 
 

@@ -50,7 +50,11 @@ from benchmark.logs import parse_logs  # noqa: E402
 from benchmark.metrics_check import (  # noqa: E402
     build_timeline,
     check_quiesce_health,
+    clock_summary,
+    corrected_stage_join,
+    critical_path_summary,
     queue_pressure_summary,
+    quorum_straggler_summary,
     wire_crypto_summary,
 )
 from benchmark.scraper import Scraper  # noqa: E402
@@ -410,6 +414,17 @@ def run_remote_bench(
     # Flight rings ride along (same convention as local_bench): each
     # node's last-seconds event history in the bench JSON.
     flight_rings = scraper.flight_all()
+    # One FULL snapshot round (stage traces + clock.offset_ms gauges)
+    # before teardown — the remote stand-in for local_bench's
+    # --metrics-path post-mortem files.  This is the input to the
+    # skew-corrected cross-node join: remote hosts have genuinely
+    # different wall clocks, so this harness is where the correction
+    # earns its keep rather than being an identity.
+    full_snaps: list = []
+    for node_name, snap in scraper.snapshot_all().items():
+        if isinstance(snap, dict):
+            snap["node"] = node_name
+            full_snaps.append(snap)
     scraper.stop()
 
     for r in runners:
@@ -463,6 +478,14 @@ def run_remote_bench(
         list(last_sample.values()), scraper.samples
     )
     result.flight = flight_rings
+    # Skew-corrected cross-node stage join over the full quiesce
+    # snapshots: per-node reconciled offsets (recorded in the bench
+    # JSON), the slowest causal chain, and the ranked quorum-straggler
+    # attribution — the same sections, same keys, as local_bench.
+    result.clock = clock_summary(full_snaps)
+    stage_ts, _seal_bytes = corrected_stage_join(full_snaps)
+    result.critical_path = critical_path_summary(stage_ts)
+    result.stragglers = quorum_straggler_summary(full_snaps)
     with open(f"{stage}/timeline.json", "w") as f:
         json.dump(result.timeline, f, indent=1)
     for r in runners:
@@ -571,6 +594,12 @@ def main() -> None:
                     "timeline": result.timeline,
                     "flight": result.flight,
                     "queues": result.queues,
+                    # Per-node reconciled clock offsets (the correction
+                    # the cross-host stage join applied), the slowest
+                    # causal chain, and the straggler table.
+                    "clock": result.clock,
+                    "critical_path": result.critical_path,
+                    "stragglers": result.stragglers,
                 }
             )
         )

@@ -167,6 +167,23 @@ class Scraper:
             remaining = retry
         return out
 
+    def snapshot_all(self) -> Dict[str, Optional[dict]]:
+        """One concurrent FULL ``/metrics.json`` round (stage/round
+        traces included, unlike the periodic ``?trace=0`` ticks): the
+        remote harness's stand-in for the --metrics-path post-mortem
+        files when nodes quiesce on other machines.  The returned
+        snapshots carry the ``clock.offset_ms.*`` gauges and trace
+        tables metrics_check's skew-corrected join and critical-path
+        extraction consume.  A node that cannot answer yields None."""
+        out: Dict[str, Optional[dict]] = {}
+        snaps = self._pool.map(
+            lambda t: fetch_json(t[1], t[2], "/metrics.json", self.timeout_s),
+            self.targets,
+        )
+        for target, (status, body) in zip(self.targets, snaps):
+            out[target[0]] = body if status == 200 else None
+        return out
+
     def flight_all(self) -> Dict[str, Optional[dict]]:
         """One concurrent ``/debug/flight`` round — each node's bounded
         event ring at quiesce, embedded in the bench JSON so even clean

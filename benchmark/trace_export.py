@@ -48,6 +48,11 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 from narwhal_tpu.metrics import ROUND_STAGES, STAGES  # noqa: E402
+from benchmark.metrics_check import (  # noqa: E402
+    corrected_stage_join,
+    critical_path_summary,
+    snapshot_correction_ms,
+)
 
 # Per-process track (tid) layout.  Fixed small integers: Perfetto sorts
 # tracks by tid within a process, so the pipeline sits on top.
@@ -55,12 +60,17 @@ TID_PIPELINE = 1   # per-digest stage slices + flow bindings
 TID_ROUNDS = 2     # per-round cadence slices
 TID_EVENTS = 3     # instants: health/flight landmarks, merged log lines
 TID_CPU = 4        # sampling profiler: main-thread leaf runs
+TID_CRITICAL = 5   # committee row: slowest end-to-end causal chains
 _TRACK_NAMES = {
     TID_PIPELINE: "pipeline (per-digest)",
     TID_ROUNDS: "rounds (cadence)",
     TID_EVENTS: "events",
     TID_CPU: "cpu (sampled)",
 }
+
+# How many of the slowest full-chain digests get a slice chain on the
+# committee critical-path row.
+CRITICAL_PATHS = 3
 
 _STAGE_IDX = {s: i for i, s in enumerate(STAGES)}
 
@@ -140,10 +150,21 @@ def build_trace(
 
     snapshots = sorted(snapshots, key=lambda kv: row_key(kv[0]))
     pids = {name: i + 1 for i, (name, _) in enumerate(snapshots)}
+    # Synthetic committee row for cross-process surfaces (the critical
+    # path spans nodes and belongs to no single process).
+    committee_pid = len(snapshots) + 1
     # Events are built on ABSOLUTE epoch microseconds and rebased to the
     # earliest one at the end — no surface (profiler boots before the
-    # first stage stamp) can land before the computed origin.
+    # first stage stamp) can land before the computed origin.  Each
+    # node's surfaces are shifted by its reconciled clock correction
+    # (passed to the emitters through the `t0` rebase argument), so
+    # cross-process flows and the critical path measure causal order on
+    # the committee's mean clock, not each host's raw wall clock.
     t0 = 0.0
+    corrections = {
+        name: snapshot_correction_ms(snap) / 1000.0
+        for name, snap in snapshots
+    }
     b = _TraceBuilder()
 
     for name, _ in snapshots:
@@ -168,8 +189,10 @@ def build_trace(
     flow_anchor: Dict[str, List[Tuple[int, str, float]]] = {}
     for name, snap in snapshots:
         pid = pids[name]
-        _emit_digest_slices(b, pid, snap, t0, flow_anchor)
-        _emit_round_slices(b, pid, snap, t0)
+        corr_s = corrections.get(name, 0.0)
+        _emit_digest_slices(b, pid, snap, corr_s, flow_anchor)
+        _emit_round_slices(b, pid, snap, corr_s)
+        _emit_clock_counters(b, pid, snap, corr_s)
         ring = (snap.get("detail") or {}).get("flight.ring") or {}
         scraped = (flight or {}).get(name)
         if scraped and _ring_newest(scraped) > _ring_newest(ring):
@@ -180,22 +203,25 @@ def build_trace(
             # but a node SIGKILLed mid-run has only a stale periodic
             # snapshot while the scrape saw it live.  Newest event wins.
             ring = scraped
-        _emit_flight(b, pid, ring, t0)
-        _emit_profile(b, pid, snap, t0)
+        _emit_flight(b, pid, ring, corr_s)
+        _emit_profile(b, pid, snap, corr_s)
         _emit_health_events(
-            b, pid, ((snap.get("health") or {}).get("events")) or [], t0
+            b, pid, ((snap.get("health") or {}).get("events")) or [],
+            corr_s,
         )
         last_stall = (snap.get("detail") or {}).get("runtime.loop_stall_last")
         if last_stall and last_stall.get("ts"):
             b.instant(
                 pid, TID_EVENTS, "loop_stall_stack",
-                _us(last_stall["ts"], t0), "runtime",
+                _us(last_stall["ts"], corr_s), "runtime",
                 {k: str(v)[:2000] for k, v in last_stall.items()},
             )
 
     # -- committee-wide surfaces ---------------------------------------------
     if timeline:
         _emit_timeline(b, pids, timeline, t0)
+
+    critical = _emit_critical_paths(b, committee_pid, snapshots)
 
     # -- cross-process digest flows -------------------------------------------
     flows, flows_total = _emit_flows(b, flow_anchor, t0, max_flows)
@@ -219,13 +245,22 @@ def build_trace(
             "flows_emitted": flows,
             "flows_total": flows_total,
             "flows_dropped": flows_total - flows,
+            "clock_corrections_ms": {
+                name: round(1000 * c, 3)
+                for name, c in corrections.items()
+                if c
+            },
+            "critical_path": critical,
         },
     }
 
 
 def _emit_digest_slices(b, pid, snap, t0, flow_anchor) -> None:
     """Leg slices between consecutive stage stamps a node owns, plus the
-    flow anchors (digest → slice starts) the flow pass binds arrows to."""
+    flow anchors (digest → slice starts) the flow pass binds arrows to.
+    ``t0`` is the node's clock correction; anchors are recorded in
+    CORRECTED time so the cross-process flow pass (which rebases all
+    rows alike) lands the arrows where the slices are."""
     for digest, entry in (snap.get("trace") or {}).items():
         stamps = sorted(
             ((s, entry[s]) for s in STAGES if s in entry),
@@ -243,7 +278,7 @@ def _emit_digest_slices(b, pid, snap, t0, flow_anchor) -> None:
                 _us(t_a, t0), _us(t_b, t0) - _us(t_a, t0),
                 "stage", {"digest": short},
             )
-            anchors.append((pid, s_a, t_a))
+            anchors.append((pid, s_a, t_a - t0))
         # A lone trailing stamp still anchors the chain's end (commit on
         # a primary whose slice ends there): bind at the LAST slice start.
         if len(stamps) == 1:
@@ -251,7 +286,7 @@ def _emit_digest_slices(b, pid, snap, t0, flow_anchor) -> None:
                 pid, TID_PIPELINE, stamps[0][0],
                 _us(stamps[0][1], t0), "stage", {"digest": short},
             )
-            anchors.append((pid, stamps[0][0], stamps[0][1]))
+            anchors.append((pid, stamps[0][0], stamps[0][1] - t0))
 
 
 def _emit_round_slices(b, pid, snap, t0) -> None:
@@ -279,6 +314,68 @@ def _emit_round_slices(b, pid, snap, t0) -> None:
                 _us(t_a, t0), _us(t_b, t0) - _us(t_a, t0),
                 "round-leg", {"round": rnd},
             )
+
+
+def _emit_clock_counters(b, pid, snap, t0) -> None:
+    """Per-peer clock-offset and uncertainty gauges as counter tracks,
+    stamped at the snapshot's write time: the correction layer made
+    visible next to the spans it corrects (a leg that still looks
+    acausal with a large offset counter underneath it is an estimator
+    problem, not a pipeline one)."""
+    ts = snap.get("ts")
+    if not isinstance(ts, (int, float)):
+        return
+    gauges = snap.get("gauges") or {}
+    for track, prefix in (
+        ("clock offset (ms)", "clock.offset_ms."),
+        ("clock uncertainty (ms)", "clock.offset_uncertainty_ms."),
+    ):
+        vals = {
+            name[len(prefix):]: v
+            for name, v in gauges.items()
+            if name.startswith(prefix) and isinstance(v, (int, float))
+        }
+        if vals:
+            b.counter_track(pid, track, _us(ts, t0), vals)
+
+
+def _emit_critical_paths(b, committee_pid, snapshots) -> dict:
+    """Slice chains for the slowest end-to-end digests on a dedicated
+    committee row, from the skew-corrected cross-node join (the same one
+    metrics_check reports).  Returns the summary for the metadata."""
+    stage_ts, _ = corrected_stage_join([snap for _, snap in snapshots])
+    summary = critical_path_summary(stage_ts, top_k=CRITICAL_PATHS)
+    if not summary.get("slowest"):
+        return summary
+    b.events.append({
+        "ph": "M", "pid": committee_pid, "tid": 0, "name": "process_name",
+        "args": {"name": "committee"},
+    })
+    b.events.append({
+        "ph": "M", "pid": committee_pid, "tid": 0,
+        "name": "process_sort_index",
+        "args": {"sort_index": committee_pid},
+    })
+    b.events.append({
+        "ph": "M", "pid": committee_pid, "tid": TID_CRITICAL,
+        "name": "thread_name", "args": {"name": "critical path"},
+    })
+    for rank, chain in enumerate(summary["slowest"], start=1):
+        st = stage_ts[chain["digest"]]
+        for a, bb in zip(STAGES[:-1], STAGES[1:]):
+            if st[bb] < st[a]:
+                continue  # residual skew beyond the correction
+            b.slice(
+                committee_pid, TID_CRITICAL, f"#{rank} {a}→{bb}",
+                _us(st[a], 0.0), _us(st[bb], 0.0) - _us(st[a], 0.0),
+                "critical-path",
+                {
+                    "digest": chain["digest"][:12],
+                    "e2e_ms": chain["e2e_ms"],
+                    "rank": rank,
+                },
+            )
+    return summary
 
 
 def _ring_newest(ring: dict) -> float:
@@ -366,6 +463,21 @@ def _emit_timeline(b, pids, timeline: dict, t0) -> None:
             }
             if qvals:
                 b.counter_track(pid, "queue depth", _us(t, t0), qvals)
+    # Per-peer RTT matrix (whole-run means from each node's last scrape)
+    # as a counter track per node, stamped at that node's last sample.
+    for name, peers in (timeline.get("rtt_ms") or {}).items():
+        pid = pids.get(name)
+        series = (timeline.get("nodes") or {}).get(name) or []
+        if pid is None or not series:
+            continue
+        t = series[-1].get("t")
+        vals = {
+            addr: e.get("mean_ms")
+            for addr, e in peers.items()
+            if isinstance(e.get("mean_ms"), (int, float))
+        }
+        if isinstance(t, (int, float)) and vals:
+            b.counter_track(pid, "peer rtt (ms)", _us(t, t0), vals)
     for ev in timeline.get("events") or []:
         pid = pids.get(ev.get("node"))
         t = ev.get("t")

@@ -71,6 +71,13 @@ LOWER_BETTER = {
     # PR 13 — the claimed lowdepth latency cut stays pinned
     # cross-revision instead of living in one A/B artifact.
     "cert_to_commit_ms",
+    # Support-quorum spread: first direct supporter → the 2f+1 arrival
+    # that closes a committed leader's support quorum (the slack the
+    # lowdepth rule converts into latency).  Graduated from the
+    # round-attribution report to a gated series: a creep here is the
+    # committee getting slower at the exact quorum the commit rule
+    # waits on, upstream of any cert_to_commit movement.
+    "support_arrival_ms",
 }
 # Pipeline stage legs (stage.<leg>) are lower-better but host-noise
 # swings them ±40% (r09/r10 artifacts), so they are tracked, not gated.
@@ -159,6 +166,18 @@ def _bench_result_metrics(d: dict) -> Dict[str, float]:
             v = _num(stages_d.get("cert_to_commit"))
     if v is not None:
         out.setdefault("cert_to_commit_ms", v)
+    # support_arrival_ms: first-class key when the artifact publishes it
+    # (bench.py from r22), else lifted from the straggler section's gap
+    # histograms (local_bench --json embeds the whole summary).
+    v = _num(d.get("support_arrival_ms"))
+    if v is None:
+        stragglers = d.get("stragglers")
+        if isinstance(stragglers, dict):
+            gap = (stragglers.get("gaps") or {}).get("support_arrival_ms")
+            if isinstance(gap, dict):
+                v = _num(gap.get("mean"))
+    if v is not None:
+        out.setdefault("support_arrival_ms", v)
     stages = d.get("stages_ms")
     if isinstance(stages, dict):
         for leg, ms in stages.items():

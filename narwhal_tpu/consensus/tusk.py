@@ -267,13 +267,15 @@ class Tusk:
         # equivocation-overwrite path recomputes instead of patching).
         self._support: Dict[Round, int] = {}
         # Optional hook fired from the incremental bump with
-        # (leader_round, old_stake, new_stake) — Consensus attaches its
-        # support-arrival-spread accounting here.  Only the hot
+        # (leader_round, old_stake, new_stake, supporter) — Consensus
+        # attaches its support-arrival-spread and straggler-attribution
+        # accounting here (the supporter whose bump crosses the quorum
+        # line is the validator that closed it).  Only the hot
         # incremental path fires it: the cold recompute paths
         # (leader-after-supporters, equivocation overwrite) reconstruct
         # stake totals but not arrival ORDER, so they stay silent.
         self.support_observer: Optional[
-            Callable[[Round, int, int], None]
+            Callable[[Round, int, int, PublicKey], None]
         ] = None
 
     def leader(self, round: Round, dag: Dag) -> Optional[Tuple[Digest, Certificate]]:
@@ -305,7 +307,9 @@ class Tusk:
                     new = old + self.committee.stake(certificate.origin)
                     self._support[r - 1] = new
                     if self.support_observer is not None:
-                        self.support_observer(r - 1, old, new)
+                        self.support_observer(
+                            r - 1, old, new, certificate.origin
+                        )
             elif (
                 r % 2 == 0
                 and r >= 2
@@ -681,16 +685,34 @@ class Consensus:
             "consensus.support_arrival_ms", metrics.LATENCY_MS_BUCKETS
         )
         self._support_first: Dict[Round, float] = {}
+        # Support-quorum straggler attribution: the validator whose
+        # direct-support bump crossed the 2f+1 line CLOSED that leader's
+        # support quorum — count it by primary address, so metrics_check
+        # can rank "which validator's luck gates the lowdepth rule"
+        # committee-wide (the gap itself is support_arrival_ms above).
+        self._m_support_straggler = {
+            n: metrics.counter(
+                f"consensus.support_straggler."
+                f"{a.primary.primary_to_primary}"
+            )
+            for n, a in committee.authorities.items()
+        }
         if self._c2c_on:
             _quorum = committee.quorum_threshold()
 
             def _observe_support(
-                leader_round: Round, old_stake: int, new_stake: int
+                leader_round: Round,
+                old_stake: int,
+                new_stake: int,
+                supporter: PublicKey,
             ) -> None:
                 now = loop_now()
                 first = self._support_first.setdefault(leader_round, now)
                 if old_stake < _quorum <= new_stake:
                     self._m_support_arrival.observe(1000.0 * (now - first))
+                    counter = self._m_support_straggler.get(supporter)
+                    if counter is not None:
+                        counter.inc()
 
             self.tusk.support_observer = _observe_support
         # Crash-recovery of the committed frontier (beyond reference

@@ -58,6 +58,7 @@ from typing import (
     Tuple,
 )
 
+from .utils.clock import wall_now
 from .utils.env import env_flag, env_float, env_int, env_str
 
 log = logging.getLogger("narwhal.metrics")
@@ -254,9 +255,13 @@ class TraceTable:
     ``mark`` keeps the FIRST timestamp per (key, stage) — matching the
     log parser's earliest-across-nodes convention — and evicts the oldest
     keys FIFO once ``cap`` is exceeded, so a long-lived node's table
-    stays bounded.  Timestamps are wall-clock (``time.time()``): the bench
-    joins stages across *processes* on the same host, which monotonic
-    clocks cannot do.
+    stays bounded.  Timestamps are wall-clock (``utils/clock.wall_now``
+    — ``time.time()`` in production): the bench joins stages across
+    *processes*, which monotonic clocks cannot do.  Cross-NODE joins of
+    these stamps additionally go through the clocksync offset correction
+    (benchmark/metrics_check) — raw wall clocks skew across hosts.
+    Under the sim, ``wall_now`` rides the virtual clock plus any
+    injected per-node skew, so traces stay bit-reproducible per seed.
     """
 
     __slots__ = ("cap", "entries", "evictions", "stages")
@@ -285,7 +290,7 @@ class TraceTable:
                 self.entries.pop(next(iter(self.entries)))
                 self.evictions += 1
             entry = self.entries[digest_hex] = {}
-        entry.setdefault(stage, ts if ts is not None else time.time())
+        entry.setdefault(stage, ts if ts is not None else wall_now())
         for k, v in extra.items():
             entry.setdefault(k, v)
 

@@ -21,10 +21,12 @@ from typing import Deque, Dict, List, Optional, Sequence, Tuple
 
 from .. import metrics
 from ..faults import netem as _netem
+from ..utils.clock import wall_now
 from ..utils.env import env_raw
 from ..utils.tasks import spawn
 from . import transport as _transport
 from . import wirev2
+from .clocksync import parse_ack, record_ack_sample
 from .framing import (
     MAX_FRAME,
     STREAM_LIMIT,
@@ -105,10 +107,12 @@ class _Msg:
     per-type protocol bytes are never inflated by link flaps — and a
     frame whose first write attempt died mid-stream still gets exactly
     one first-transmission count when it finally lands).  ``t0`` is the
-    write timestamp while pending, for the per-peer ACK-RTT histogram.
+    write timestamp while pending, for the per-peer ACK-RTT histogram;
+    ``t0_wall`` is the same instant on the wall clock, paired with the
+    peer's stamped ACK for the clock-offset estimator (clocksync).
     """
 
-    __slots__ = ("data", "fut", "msg_type", "accounted", "t0")
+    __slots__ = ("data", "fut", "msg_type", "accounted", "t0", "t0_wall")
 
     def __init__(self, data: bytes, fut: asyncio.Future, msg_type: str):
         self.data = data
@@ -116,6 +120,7 @@ class _Msg:
         self.msg_type = msg_type
         self.accounted = False
         self.t0 = 0.0
+        self.t0_wall = 0.0
 
 # Counters are shared by every ReliableSender in the process (one registry
 # per process); the per-peer detail below disaggregates when needed.
@@ -348,6 +353,7 @@ class _Connection:
                         # retransmits it rather than losing the message
                         # and wedging its future.
                         item.t0 = loop.time()
+                        item.t0_wall = wall_now()
                         self.pending.append(item)
                         # lint: allow-interleave(_requeue_pending only runs after _exchange's finally has cancelled AND awaited this task — "let cancellation unwind so neither loop touches the deques after we return" — so the buffer/pending writes it performs can never interleave with this suspended frame write; read_loop only popleft()s entries this loop appended before the suspension, which is exactly the ACK-FIFO contract)
                         await write_frame(writer, item.data)
@@ -400,6 +406,7 @@ class _Connection:
                             item.data, item.msg_type, enc_dict
                         )
                         item.t0 = loop.time()
+                        item.t0_wall = wall_now()
                         self.pending.append(item)
                         blob += frame(payload)
                         wrote.append((item, len(payload)))
@@ -441,6 +448,14 @@ class _Connection:
                 if self.pending:
                     item = self.pending.popleft()
                     self._m_rtt.observe(loop.time() - item.t0)
+                    # Stamped ACK → one NTP-style offset sample for this
+                    # peer (legacy bare b"Ack" parses to None: mixed
+                    # committees degrade to RTT-only, never fail).
+                    t_peer = parse_ack(ack)
+                    if t_peer is not None and item.t0_wall:
+                        record_ack_sample(
+                            self.address, item.t0_wall, wall_now(), t_peer
+                        )
                     if not item.fut.done():
                         item.fut.set_result(ack)
 

@@ -61,6 +61,7 @@ from ..crypto import Digest, PublicKey, SignatureService
 from ..messages import Round
 from ..network import ReliableSender
 from ..store import Store
+from ..utils.clock import loop_now
 from ..utils.env import env_flag, env_float, env_int
 from ..utils.serde import Writer
 from .aggregators import CertificatesAggregator, VotesAggregator
@@ -261,6 +262,27 @@ class Core:
             for n, a in self.primary_addresses.items()
             if n != name
         }
+        # Quorum-straggler attribution (causal commit tracer): when a
+        # vote quorum or a parent quorum completes, the authority whose
+        # message CLOSED it is charged by primary address, and the span
+        # from that quorum's first arrival to completion lands in a gap
+        # histogram.  Both ride the loop clock — wall on a live node,
+        # virtual (bit-reproducible) under the sim.  The emit-once
+        # aggregator contract (weight reset at quorum, authority-reuse
+        # rejection/dedupe) is what makes the charge exactly-once per
+        # completion, duplicates and equivocations included.
+        self._m_quorum_straggler = {
+            n: metrics.counter(f"primary.quorum_straggler.{a}")
+            for n, a in self.primary_addresses.items()
+        }
+        self._m_vote_quorum_gap = metrics.histogram(
+            "primary.vote_quorum_gap_ms", metrics.LATENCY_MS_BUCKETS
+        )
+        self._m_parent_quorum_gap = metrics.histogram(
+            "primary.parent_quorum_gap_ms", metrics.LATENCY_MS_BUCKETS
+        )
+        self._vote_first_ts: Optional[float] = None
+        self._parent_first_ts: Dict[Round, float] = {}
         # Crypto-cost ledger, burst side: signature claims entering the
         # batched verify PER MESSAGE KIND.  The backend's per-site
         # instruments see the whole burst as "batch_burst"; these split
@@ -303,6 +325,7 @@ class Core:
         else:
             self._m_headers_empty.inc()
         self.votes_aggregator = VotesAggregator()
+        self._vote_first_ts = None  # fresh quorum, fresh first-arrival
         handlers = self._broadcast_own_header(header)
         self._rtrace.mark(str(header.round), "header_broadcast")
         self.cancel_handlers.setdefault(header.round, []).extend(handlers)
@@ -449,6 +472,8 @@ class Core:
         log.debug("Processing %r", vote)
         self._m_votes_in.inc()
         self._rtrace.mark(str(vote.round), "first_vote")
+        if self._vote_first_ts is None:
+            self._vote_first_ts = loop_now()
         certificate = self.votes_aggregator.append(
             vote, self.committee, self.current_header
         )
@@ -456,6 +481,16 @@ class Core:
             log.debug("Assembled %r", certificate)
             self._m_certs_formed.inc()
             self._rtrace.mark(str(certificate.round), "vote_quorum")
+            # This vote CLOSED the quorum: charge its author and record
+            # the first-arrival→completion gap (usually our own instant
+            # self-vote opens the window, so the gap prices how long the
+            # 2f+1-th validator made the certificate wait).
+            self._m_vote_quorum_gap.observe(
+                1000.0 * (loop_now() - self._vote_first_ts)
+            )
+            straggler = self._m_quorum_straggler.get(vote.author)
+            if straggler is not None:
+                straggler.inc()
             # Stage trace: OUR header just got certified — the payload
             # digests it carries cross the header→certificate boundary.
             for digest in certificate.header.payload:
@@ -506,11 +541,28 @@ class Core:
             )
 
         # Enough certificates to advance the DAG round?
-        parents = self.certificates_aggregators.setdefault(
+        aggregator = self.certificates_aggregators.setdefault(
             certificate.round, CertificatesAggregator()
-        ).append(certificate, self.committee)
+        )
+        if (
+            certificate.origin not in aggregator.used
+            and certificate.round not in self._parent_first_ts
+        ):
+            # First FRESH certificate of this round's parent quorum
+            # (origin-dedupe means a re-delivery never opens the window).
+            self._parent_first_ts[certificate.round] = loop_now()
+        parents = aggregator.append(certificate, self.committee)
         if parents is not None:
             self._rtrace.mark(str(certificate.round), "parent_quorum")
+            first_ts = self._parent_first_ts.get(certificate.round)
+            if first_ts is not None:
+                self._m_parent_quorum_gap.observe(
+                    1000.0 * (loop_now() - first_ts)
+                )
+            # This certificate CLOSED the round's parent quorum.
+            straggler = self._m_quorum_straggler.get(certificate.origin)
+            if straggler is not None:
+                straggler.inc()
             if self.parents_cb is not None:
                 # Synchronous hand-off to the Proposer: the round advances
                 # at quorum time, not a queue round-trip later.
@@ -676,6 +728,7 @@ class Core:
                 self.equivocation_ids,
                 self.processing,
                 self.certificates_aggregators,
+                self._parent_first_ts,
             ):
                 for k in [k for k in m if k < gc_round]:
                     del m[k]

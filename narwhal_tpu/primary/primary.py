@@ -23,6 +23,7 @@ from ..messages import (
     set_wire_committee,
 )
 from ..network import Receiver, Writer
+from ..network.clocksync import stamp_ack
 from ..store import Store
 from ..utils.env import env_int
 from ..utils.tasks import spawn
@@ -55,7 +56,7 @@ class PrimaryReceiverHandler:
         except ValueError as e:
             log.warning("Dropping malformed primary message: %s", e)
             return
-        await writer.send(b"Ack")
+        await writer.send(stamp_ack())
         if decoded[0] == "certificates_request":
             await self.tx_helper.put((decoded[1], decoded[2]))
         else:

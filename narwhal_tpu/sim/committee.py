@@ -54,8 +54,10 @@ from ..crypto.keys import set_sim_mac
 from ..faults.byzantine import ByzantinePlan
 from ..faults.spec import FaultScenario
 from ..metrics import HealthMonitor, default_rules
+from ..network import clocksync
 from ..network import transport as net_seam
 from ..network.framing import frame
+from ..utils.clock import set_wall_base, skew_scope
 from ..utils.tasks import spawn
 from .clock import run_virtual
 from .transport import SimTransport, compile_wan
@@ -210,6 +212,7 @@ def run_sim_scenario(
     max_virtual_s: Optional[float] = None,
     commit_rule: Optional[str] = None,
     large_n_rate_cap: Optional[int] = 60,
+    clock_skew_ms: Optional[Dict[int, float]] = None,
 ) -> dict:
     """Run one scenario arm in simulation; returns the artifact dict
     (see module docstring).  ``consensus_cls_by_node`` swaps a node's
@@ -220,7 +223,12 @@ def run_sim_scenario(
     no further plumbing.  ``large_n_rate_cap`` is the extra offered-load
     clamp applied above 10 nodes (wall cost of the sim is linear in
     frames); the knee matrix passes ``None`` to sweep real rates at
-    N=10/20."""
+    N=10/20.  ``clock_skew_ms`` maps authority index → injected wall-
+    clock skew: that authority's whole plane (primary + workers) stamps
+    traces and ACKs with a clock running that far ahead/behind the
+    virtual truth — the skew-injection arm that validates the clocksync
+    correction against known ground truth (the protocol itself never
+    reads the wall clock, so the schedule is skew-invariant)."""
     import os
     import shutil
 
@@ -294,10 +302,20 @@ def run_sim_scenario(
         for name in [
             n for n in pool
             if n.startswith(
-                ("primary.peer_votes.", "net.reliable.peer.", "detect.")
+                (
+                    "primary.peer_votes.",
+                    "primary.quorum_straggler.",
+                    "consensus.support_straggler.",
+                    "net.reliable.peer.",
+                    "clock.",
+                    "detect.",
+                )
             )
         ]:
             del pool[name]
+    # Clock-offset estimators are keyed by the previous run's committee
+    # too, and a retained smoothed estimate would leak into this run.
+    clocksync.reset_estimators()
     gc.collect()
     random.seed(scenario.seed ^ (run_seed * 2654435761))
 
@@ -316,6 +334,10 @@ def run_sim_scenario(
         loop = asyncio.get_running_loop()
         start = loop.time()
         transport.anchor(start)
+        # Wall stamps (trace tables, ACK clock stamps) ride the virtual
+        # clock — deterministic per (seed, spec) — plus each node's
+        # injected skew; uninstalled in the run's outer finally.
+        set_wall_base(loop.time)
 
         prim_stores = {i: Store(None) for i in range(scenario.nodes)}
         worker_stores = {
@@ -345,13 +367,16 @@ def run_sim_scenario(
             audit = os.path.join(workdir, f"audit-primary-{i}.seg{inc}.bin")
             audit_segments.setdefault(i, []).append(audit)
             plan = plans.get(i)
+            # One injected skew per AUTHORITY (primary + its workers):
+            # the physical model is one mis-synced host per validator.
+            skew_s = (clock_skew_ms or {}).get(i, 0.0) / 1000.0
             # node_scope: detection counters built by this authority's
             # components also feed per-node `detect.*` shadows, so the
             # verdict can name WHICH validator observed the evidence (the
             # one registry is otherwise committee-aggregated).
             with transport.node(f"primary-{i}"), reg.node_scope(
                 f"primary-{i}"
-            ):
+            ), skew_scope(skew_s):
                 primaries[i] = await spawn_primary_node(
                     keypairs[i],
                     committee,
@@ -382,7 +407,7 @@ def run_sim_scenario(
                 # (the verdict's node names are primary-<i>).
                 with transport.node(f"worker-{i}-{wid}"), reg.node_scope(
                     f"primary-{i}"
-                ):
+                ), skew_scope(skew_s):
                     ws.append(
                         await spawn_worker_node(
                             keypairs[i],
@@ -558,6 +583,7 @@ def run_sim_scenario(
     finally:
         set_sim_mac(False)
         set_decode_cache(False)
+        set_wall_base(None)
         net_seam.reset()
         reg.health = None
 
@@ -649,6 +675,86 @@ def run_sim_scenario(
             else None
         ),
     }
+    # Clock-offset estimation, judged against injected ground truth: the
+    # sim's channels feed per-(source node, destination address) offset
+    # estimators (clocksync — the shared registry cannot carry per-node
+    # gauges), mapped back to authorities here and reconciled with the
+    # SAME zero-mean formula metrics_check applies to live snapshots.
+    # Everything rides the virtual clock, so the section is part of the
+    # deterministic blob: offsets are bit-reproducible per (seed, spec).
+    addr_to_auth: Dict[str, int] = {}
+    for i, nm in enumerate(names):
+        auth = committee.authorities[nm]
+        addr_to_auth[auth.primary.primary_to_primary] = i
+        addr_to_auth[auth.primary.worker_to_primary] = i
+        for w in auth.workers.values():
+            for a in (
+                w.transactions, w.worker_to_worker, w.primary_to_worker
+            ):
+                addr_to_auth[a] = i
+
+    def _label_auth(label: str) -> Optional[int]:
+        parts = label.split("-")
+        if parts[0] in ("primary", "worker") and len(parts) > 1:
+            try:
+                return int(parts[1])
+            except ValueError:
+                return None
+        return None
+
+    pairwise: Dict[int, Dict[int, List[float]]] = {}
+    for src_label, peers in clocksync.offsets_by_source().items():
+        s = _label_auth(src_label)
+        if s is None:
+            continue
+        for addr, info in peers.items():
+            d = addr_to_auth.get(addr)
+            if d is None or d == s:
+                continue
+            pairwise.setdefault(s, {}).setdefault(d, []).append(
+                info["offset_ms"]
+            )
+    peer_offsets_ms = {
+        f"primary-{s}": {
+            f"primary-{d}": round(sum(v) / len(v), 3)
+            for d, v in sorted(peers.items())
+        }
+        for s, peers in sorted(pairwise.items())
+    }
+    clock = {
+        "injected_skew_ms": {
+            f"primary-{i}": v
+            for i, v in sorted((clock_skew_ms or {}).items())
+        },
+        "peer_offsets_ms": peer_offsets_ms,
+        "reconciled_ms": {
+            node: round(v, 3)
+            for node, v in clocksync.reconcile_zero_mean(
+                peer_offsets_ms
+            ).items()
+        },
+    }
+
+    # Quorum-straggler attribution over the shared registry, with the
+    # per-address counters folded back to authority labels.  Counts are
+    # schedule-determined — also inside the deterministic blob.
+    stragglers: Dict[str, Dict[str, int]] = {}
+    for section, prefix in (
+        ("quorum", "primary.quorum_straggler."),
+        ("support", "consensus.support_straggler."),
+    ):
+        agg: Dict[str, int] = {}
+        for counter_name, c in reg.counters.items():
+            if counter_name.startswith(prefix) and c.value > 0:
+                idx = addr_to_auth.get(counter_name[len(prefix):])
+                label = (
+                    f"primary-{idx}"
+                    if idx is not None
+                    else counter_name[len(prefix):]
+                )
+                agg[label] = agg.get(label, 0) + c.value
+        stragglers[section] = dict(sorted(agg.items()))
+
     # Per-channel backpressure accounting over the shared registry: the
     # sim runs the whole committee in one process, so channel series
     # aggregate committee-wide (same convention as the queue-depth
@@ -675,6 +781,8 @@ def run_sim_scenario(
         "commit_rule": _effective_rule(commit_rule),
         "cert_to_commit": cert_to_commit,
         "support_arrival": support_arrival,
+        "clock": clock,
+        "stragglers": stragglers,
         "queues": queues,
         "parameters": params.to_json(),
         "verdicts": {

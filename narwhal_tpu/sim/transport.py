@@ -35,12 +35,14 @@ from __future__ import annotations
 
 import asyncio
 import collections
+import contextvars
 import random
 import zlib
 from typing import Deque, Dict, List, Optional, Tuple
 
 from .. import metrics
 from ..faults.netem import Shape, resolve_wan_plane
+from ..network.clocksync import parse_ack, record_ack_sample
 from ..network.framing import MAX_FRAME, parse_address
 from ..network.reliable_sender import (
     _BACKOFF_START,
@@ -48,6 +50,7 @@ from ..network.reliable_sender import (
     _peer_instruments,
     next_backoff,
 )
+from ..utils.clock import current_skew, wall_now
 from ..utils.tasks import spawn
 
 _m_frames = metrics.counter("net.sim.frames_delivered")
@@ -287,6 +290,13 @@ class _SimReceiver:
         self.classify = classify
         self._channels: Dict[Tuple, Tuple[Deque, asyncio.Event, asyncio.Task]] = {}
         self._closed = False
+        # The receiver is constructed inside its node's boot scope, but
+        # channel tasks are spawned lazily from the SENDER's context
+        # (enqueue fires in the sending channel's task or a timer).
+        # Capture the boot context so handlers — which stamp ACKs with
+        # wall_now() — run under THIS node's injected clock skew, not
+        # whichever sender happened to deliver the first frame.
+        self._ctx = contextvars.copy_context()
 
     @property
     def port(self) -> int:
@@ -300,7 +310,9 @@ class _SimReceiver:
         if chan is None:
             q: Deque = collections.deque()
             ev = asyncio.Event()
-            task = spawn(self._chan_loop(q, ev), name="sim-recv-chan")
+            task = self._ctx.run(
+                spawn, self._chan_loop(q, ev), name="sim-recv-chan"
+            )
             chan = self._channels[chan_key] = (q, ev, task)
         q, ev, _ = chan
         q.append((data, msg_type, reply_cb))
@@ -378,6 +390,11 @@ class _SimRelChannel:
         self._inflight: Deque = collections.deque()
         loop = asyncio.get_running_loop()
         self.created = loop.time()
+        # The channel is created from the sending node's task context;
+        # remember its skew so the ACK-receive stamp can be re-expressed
+        # on the SENDER's clock (the _acked callback runs in the
+        # receiver's channel-loop context).
+        self.src_skew = current_skew()
         (
             self._m_rtt,
             self._m_peer_retrans,
@@ -449,10 +466,22 @@ class _SimRelChannel:
             due = max(now + delay_s, self.last_due)
             self.last_due = due
             t0 = now
+            t0_wall = wall_now()  # sender context: carries src skew
             fut = msg.fut
 
-            def _acked(payload: bytes, fut=fut, t0=t0) -> None:
+            def _acked(
+                payload: bytes, fut=fut, t0=t0, t0_wall=t0_wall
+            ) -> None:
                 self._m_rtt.observe(loop.time() - t0)
+                # Same piggyback offset sampling as the TCP read_loop,
+                # with the receive stamp mapped back onto the sender's
+                # clock (this callback fires in the receiver's context).
+                t_peer = parse_ack(payload)
+                if t_peer is not None:
+                    t_recv = wall_now() - current_skew() + self.src_skew
+                    record_ack_sample(
+                        self.dst, t0_wall, t_recv, t_peer, src=self.src
+                    )
                 if not fut.done():
                     fut.set_result(payload)
 

@@ -27,6 +27,7 @@ from ..messages import (
     set_wire_committee,
 )
 from ..network import Receiver, Writer
+from ..network.clocksync import stamp_ack
 from ..store import Store
 from ..utils.env import env_flag, env_int, positive_int
 from ..utils.tasks import spawn
@@ -129,7 +130,7 @@ class WorkerReceiverHandler:
                 self._m_malformed.inc()
                 log.warning("Dropping malformed batch frame")
                 return
-            await writer.send(b"Ack")
+            await writer.send(stamp_ack())
             self._m_batches_in.inc()
             self._m_batch_bytes_in.inc(len(message))
             await self.others_queue.put(message)
@@ -151,7 +152,7 @@ class WorkerReceiverHandler:
             self._m_malformed.inc()
             log.warning("Dropping malformed worker message: %s", e)
             return
-        await writer.send(b"Ack")
+        await writer.send(stamp_ack())
         _, digests, requestor = decoded
         await self.helper_queue.put((digests, requestor))
 

@@ -1,0 +1,453 @@
+"""Clock-offset estimation, skew-corrected joins, and quorum-straggler
+attribution (PR 17).
+
+Three layers under test:
+
+- the estimator itself (narwhal_tpu/network/clocksync.py): stamped-ACK
+  wire format, RTT gating, and the zero-mean reconciliation algebra;
+- the harness-side correction (benchmark/metrics_check.py): per-node
+  corrections from snapshot gauges, the corrected cross-node stage join
+  recovering ground-truth legs from skewed stamps, critical-path
+  telescoping, and the straggler ranking;
+- the sim skew-injection arm (narwhal_tpu/sim/committee.py
+  ``clock_skew_ms``): injected per-node wall skew must show up in the
+  UNCORRECTED pairwise offsets as exactly the skew delta, the
+  reconciled vector must recover the injected ground truth, and the
+  whole clock section must be bit-reproducible per (seed, spec).
+"""
+
+import asyncio
+
+import pytest
+
+from narwhal_tpu import metrics
+from narwhal_tpu.network import clocksync
+from narwhal_tpu.network.clocksync import (
+    OffsetEstimator,
+    parse_ack,
+    reconcile_zero_mean,
+    record_ack_sample,
+    stamp_ack,
+)
+
+from benchmark.metrics_check import (
+    STAGE_ORDER,
+    clock_summary,
+    corrected_stage_join,
+    critical_path_summary,
+    quorum_straggler_summary,
+    snapshot_correction_ms,
+)
+
+
+# -- wire format ---------------------------------------------------------------
+
+
+def test_stamped_ack_roundtrips_and_legacy_parses_to_none():
+    ack = stamp_ack()
+    assert ack.startswith(b"Ack") and len(ack) == 11
+    t = parse_ack(ack)
+    assert isinstance(t, float) and t > 0
+    # Legacy bare ACK (pre-PR-17 peer, and every test stub): no stamp,
+    # no sample — the sender must treat it as a plain acknowledgment.
+    assert parse_ack(b"Ack") is None
+    assert parse_ack(b"") is None
+    assert parse_ack(b"Nak" + bytes(8)) is None
+
+
+# -- estimator -----------------------------------------------------------------
+
+
+def test_estimator_rejects_congested_round_trips():
+    est = OffsetEstimator()
+    assert est.add(10.0, rtt_s=0.010)  # first sample always folds
+    assert est.samples == 1 and est.offset_s == 10.0
+    # A round trip far beyond the best-seen RTT carries an asymmetry
+    # bound wider than the signal: rejected, estimate unchanged.
+    assert not est.add(99.0, rtt_s=1.0)
+    assert est.samples == 1 and est.offset_s == 10.0
+    # A comparable-RTT sample folds (EWMA toward the new value).
+    assert est.add(12.0, rtt_s=0.012)
+    assert est.samples == 2 and 10.0 < est.offset_s < 12.0
+
+
+def test_record_ack_sample_drives_live_gauges():
+    reg = metrics.registry()
+    clocksync.reset_estimators()
+    try:
+        # offset = t_peer - midpoint(send, recv) = 100.05 - 100.005
+        record_ack_sample("10.0.0.7:4000", 100.0, 100.01, 100.05)
+        g = reg.gauges["clock.offset_ms.10.0.0.7:4000"]
+        assert g.value == pytest.approx(45.0, abs=0.01)
+        u = reg.gauges["clock.offset_uncertainty_ms.10.0.0.7:4000"]
+        assert u.value == pytest.approx(5.0, abs=0.01)
+        # Labelled (sim) sources stay OUT of the shared gauges and land
+        # in the per-source estimator table instead.
+        record_ack_sample("10.0.0.8:4000", 100.0, 100.01, 100.05,
+                          src="primary-1")
+        assert "clock.offset_ms.10.0.0.8:4000" not in reg.gauges
+        assert "10.0.0.8:4000" in clocksync.offsets_by_source()["primary-1"]
+    finally:
+        clocksync.reset_estimators()
+        for name in [n for n in reg.gauges if n.startswith("clock.")]:
+            del reg.gauges[name]
+
+
+def test_reconcile_zero_mean_recovers_centered_skew():
+    # True skews: a=+250, b=-250, c=0, d=0 (zero-mean already).  Each
+    # node's gauge for a peer reads skew_peer - skew_self.
+    skew = {"a": 250.0, "b": -250.0, "c": 0.0, "d": 0.0}
+    peer_offsets = {
+        n: {p: skew[p] - skew[n] for p in skew if p != n} for n in skew
+    }
+    out = reconcile_zero_mean(peer_offsets)
+    for n, s in skew.items():
+        assert out[n] == pytest.approx(s, abs=1e-9)
+    # Non-zero-mean skew vector: recovered up to the common shift (the
+    # estimator can only see relative offsets).
+    skew2 = {"a": 300.0, "b": 100.0}
+    po2 = {
+        n: {p: skew2[p] - skew2[n] for p in skew2 if p != n} for n in skew2
+    }
+    out2 = reconcile_zero_mean(po2)
+    mean = sum(skew2.values()) / len(skew2)
+    for n, s in skew2.items():
+        assert out2[n] == pytest.approx(s - mean, abs=1e-9)
+    assert reconcile_zero_mean({"n": {}}) == {"n": 0.0}
+
+
+# -- metrics_check correction layer --------------------------------------------
+
+
+def _skewed_snapshots():
+    """Two-node run with ±250 ms wall skew.  Ground truth: every leg of
+    the digest's chain is 50 ms; odd stages stamped on node B.  Each
+    node's stamps carry its own skew; the gauges carry what the
+    estimator would have measured (peer skew minus own skew)."""
+    base = 1000.0
+    truth = {s: base + 0.05 * i for i, s in enumerate(STAGE_ORDER)}
+    skew_a, skew_b = 0.25, -0.25
+    trace_a = {
+        s: t + skew_a for i, (s, t) in enumerate(truth.items()) if i % 2 == 0
+    }
+    trace_a["bytes"] = 512
+    trace_b = {
+        s: t + skew_b for i, (s, t) in enumerate(truth.items()) if i % 2 == 1
+    }
+    snap_a = {
+        "node": "primary-0",
+        "gauges": {"clock.offset_ms.B": -500.0},
+        "trace": {"d1": trace_a},
+    }
+    snap_b = {
+        "node": "primary-1",
+        "gauges": {"clock.offset_ms.A": 500.0},
+        "trace": {"d1": trace_b},
+    }
+    return snap_a, snap_b, truth
+
+
+def test_corrected_join_recovers_zero_skew_ground_truth():
+    snap_a, snap_b, truth = _skewed_snapshots()
+    # The corrections themselves: ±250 ms, recovered from one gauge each.
+    assert snapshot_correction_ms(snap_a) == pytest.approx(250.0)
+    assert snapshot_correction_ms(snap_b) == pytest.approx(-250.0)
+    joined, seal_bytes = corrected_stage_join([snap_a, snap_b])
+    assert seal_bytes == {"d1": 512}
+    for s, t in truth.items():
+        assert joined["d1"][s] == pytest.approx(t, abs=1e-6), s
+    # The UNCORRECTED join is off by the skew: cross-node legs swing by
+    # ±500 ms and even go acausal (the PR 6 localtime-parse bug shape).
+    snap_a2 = {k: v for k, v in snap_a.items() if k != "gauges"}
+    snap_b2 = {k: v for k, v in snap_b.items() if k != "gauges"}
+    raw, _ = corrected_stage_join([snap_a2, snap_b2])
+    first_leg = raw["d1"][STAGE_ORDER[1]] - raw["d1"][STAGE_ORDER[0]]
+    assert first_leg == pytest.approx(0.05 - 0.5, abs=1e-6)  # acausal
+    assert clock_summary([snap_a, snap_b])["primary-0"][
+        "correction_ms"
+    ] == pytest.approx(250.0)
+
+
+def test_critical_path_legs_telescope_to_e2e():
+    snap_a, snap_b, _ = _skewed_snapshots()
+    joined, _ = corrected_stage_join([snap_a, snap_b])
+    # A second, faster chain: the summary must rank the slow one first.
+    joined["d2"] = {
+        s: 2000.0 + 0.001 * i for i, s in enumerate(STAGE_ORDER)
+    }
+    # A partial chain (never committed): counted out of full_chains.
+    joined["d3"] = {STAGE_ORDER[0]: 3000.0}
+    out = critical_path_summary(joined, top_k=2)
+    assert out["full_chains"] == 2
+    assert out["path"]["digest"] == "d1"
+    assert [c["digest"] for c in out["slowest"]] == ["d1", "d2"]
+    for chain in out["slowest"]:
+        assert chain["legs_sum_ms"] == pytest.approx(
+            chain["e2e_ms"], abs=0.01
+        )
+    assert out["path"]["e2e_ms"] == pytest.approx(
+        50.0 * (len(STAGE_ORDER) - 1), abs=0.01
+    )
+    assert critical_path_summary({}) == {"full_chains": 0}
+
+
+def test_quorum_straggler_summary_ranks_most_charged_first():
+    snaps = [
+        {
+            "counters": {
+                "primary.quorum_straggler.127.0.0.1:1": 3,
+                "primary.quorum_straggler.127.0.0.1:2": 7,
+                "consensus.support_straggler.127.0.0.1:1": 2,
+            },
+            "histograms": {
+                "primary.vote_quorum_gap_ms": {"sum": 30.0, "count": 10},
+                "consensus.support_arrival_ms": {"sum": 84.0, "count": 2},
+            },
+        },
+        {
+            "counters": {"primary.quorum_straggler.127.0.0.1:1": 5},
+            "histograms": {},
+        },
+    ]
+    out = quorum_straggler_summary(snaps)
+    assert [e["address"] for e in out["vote_quorum"]] == [
+        "127.0.0.1:1", "127.0.0.1:2",
+    ]
+    assert out["vote_quorum"][0]["count"] == 8
+    assert out["support_quorum"] == [
+        {"address": "127.0.0.1:1", "count": 2}
+    ]
+    assert out["gaps"]["vote_quorum_gap_ms"]["mean"] == pytest.approx(3.0)
+    assert out["gaps"]["support_arrival_ms"]["count"] == 2
+
+
+# -- straggler attribution at the protocol layer -------------------------------
+
+
+def test_vote_quorum_charges_exactly_the_closing_voter():
+    """Of the 2f+1 votes that assemble our certificate, only the author
+    of the quorum-CROSSING vote is charged; a duplicate re-delivery of
+    an already-counted vote (AuthorityReuse) charges nobody."""
+    from tests.common import committee, keys, make_header, make_votes
+    from tests.test_core import make_core
+
+    async def go():
+        c = committee(base_port=13900)
+        me = keys()[0]
+        core, store, qs = make_core(c, me)
+        reg = metrics.registry()
+        gap_before = reg.histograms["primary.vote_quorum_gap_ms"].count
+        header = make_header(me, c=c)
+        core.current_header = header
+        votes = make_votes(header)  # the three other authorities, in order
+        base = {
+            n: core._m_quorum_straggler[n].value
+            for n in core._m_quorum_straggler
+        }
+        for vote in votes:
+            await core._handle("primaries", ("vote", vote), sig_ok=True)
+        charged = {
+            n: core._m_quorum_straggler[n].value - base[n]
+            for n in core._m_quorum_straggler
+        }
+        # Exactly ONE authority charged: the third (2f+1-th) voter.
+        assert charged == {
+            n: (1 if n == votes[-1].author else 0) for n in charged
+        }
+        assert (
+            reg.histograms["primary.vote_quorum_gap_ms"].count
+            == gap_before + 1
+        )
+        # Duplicate re-delivery of an already-counted vote: the
+        # aggregator raises AuthorityReuse into the DagError handler —
+        # nobody is (re-)charged, no second gap observation.
+        await core._handle("primaries", ("vote", votes[0]), sig_ok=True)
+        after = {
+            n: core._m_quorum_straggler[n].value - base[n]
+            for n in core._m_quorum_straggler
+        }
+        assert after == charged
+        assert (
+            reg.histograms["primary.vote_quorum_gap_ms"].count
+            == gap_before + 1
+        )
+        core.network.close()
+
+    asyncio.run(asyncio.wait_for(go(), 20))
+
+
+def test_parent_quorum_charges_once_despite_redelivery():
+    """The certificate whose arrival completes the round's parent quorum
+    is charged exactly once; re-delivered copies (origin-deduped by the
+    aggregator) neither advance the quorum nor charge anyone."""
+    from tests.common import committee, keys, make_certificate, make_header
+    from tests.test_core import make_core
+
+    async def go():
+        c = committee(base_port=14000)
+        me = keys()[0]
+        core, store, qs = make_core(c, me)
+        reg = metrics.registry()
+        gap_before = reg.histograms["primary.parent_quorum_gap_ms"].count
+        certs = [
+            make_certificate(make_header(kp, c=c)) for kp in keys()[:3]
+        ]
+        base = {
+            n: core._m_quorum_straggler[n].value
+            for n in core._m_quorum_straggler
+        }
+        await core.process_certificate(certs[0])
+        # Re-deliver the first certificate before quorum: deduped.
+        await core.process_certificate(certs[0])
+        await core.process_certificate(certs[1])
+        await core.process_certificate(certs[2])  # closes the quorum
+        # Late re-delivery after quorum: silent again.
+        await core.process_certificate(certs[1])
+        charged = {
+            n: core._m_quorum_straggler[n].value - base[n]
+            for n in core._m_quorum_straggler
+        }
+        assert charged == {
+            n: (1 if n == certs[2].origin else 0) for n in charged
+        }
+        assert (
+            reg.histograms["primary.parent_quorum_gap_ms"].count
+            == gap_before + 1
+        )
+        core.network.close()
+
+    asyncio.run(asyncio.wait_for(go(), 20))
+
+
+def test_support_quorum_charges_the_crossing_supporter_once():
+    """The round-(r+1) certificate whose direct-support bump crosses
+    2f+1 closes the leader's support quorum: exactly one charge, and
+    neither idempotent re-inserts nor an equivocation overwrite (the
+    cold recompute path) fire the observer again."""
+    from narwhal_tpu.consensus import Consensus
+    from narwhal_tpu.primary.messages import Certificate, Header
+    from tests.common import committee
+    from tests.test_consensus import (
+        genesis_digests,
+        make_certificates,
+        sorted_names,
+    )
+
+    async def go():
+        reg = metrics.registry()
+        c = committee(base_port=14100)
+        names = sorted_names()
+        cons = Consensus(
+            c, 50, asyncio.Queue(), asyncio.Queue(), asyncio.Queue(),
+            fixed_coin=True,
+        )
+        addr = {
+            n: a.primary.primary_to_primary
+            for n, a in c.authorities.items()
+        }
+        base = {
+            n: reg.counters[f"consensus.support_straggler.{addr[n]}"].value
+            for n in names
+        }
+        sa_before = reg.histograms["consensus.support_arrival_ms"].count
+        certs, _ = make_certificates(1, 3, genesis_digests(c), names)
+        for cert in certs:
+            cons.tusk.process_certificate(cert)
+        charged = {
+            n: reg.counters[f"consensus.support_straggler.{addr[n]}"].value
+            - base[n]
+            for n in names
+        }
+        # Round-3 certificates all support the round-2 leader; the THIRD
+        # one (2f+1 stake) crossed the line.
+        closer = [x for x in certs if x.round == 3][2].origin
+        assert charged == {n: (1 if n == closer else 0) for n in names}
+        assert (
+            reg.histograms["consensus.support_arrival_ms"].count
+            == sa_before + 1
+        )
+        # Idempotent re-insert of the whole round: observer stays quiet.
+        for cert in certs:
+            cons.tusk.insert_certificate(cert)
+        # Equivocation overwrite of a round-3 slot: different parent set,
+        # same (round, origin) — the cold recompute path is silent by
+        # design (arrival order is gone).
+        r2 = {x.digest() for x in certs if x.round == 2}
+        twin = Certificate(
+            header=Header(
+                author=names[3], round=3, payload={},
+                parents=set(sorted(r2)[:3]),
+            )
+        )
+        cons.tusk.insert_certificate(twin)
+        after = {
+            n: reg.counters[f"consensus.support_straggler.{addr[n]}"].value
+            - base[n]
+            for n in names
+        }
+        assert after == charged
+        assert (
+            reg.histograms["consensus.support_arrival_ms"].count
+            == sa_before + 1
+        )
+
+    asyncio.run(asyncio.wait_for(go(), 20))
+
+
+# -- sim skew-injection arm ----------------------------------------------------
+
+
+def _skew_spec():
+    from narwhal_tpu.faults.spec import parse_scenario
+
+    return parse_scenario({
+        "name": "sim_t_skew", "nodes": 4, "workers": 1, "rate": 400,
+        "tx_size": 256, "duration": 12, "seed": 5,
+    })
+
+
+def test_sim_skew_injection_recovered_and_bit_reproducible(tmp_path):
+    """±250 ms injected wall skew: the uncorrected pairwise offsets are
+    off by exactly the skew delta, the reconciled vector recovers the
+    injected ground truth, the protocol itself is skew-invariant (all
+    verdicts still pass), and the whole clock section is inside the
+    deterministic blob — byte-identical across two runs of the same
+    (seed, spec)."""
+    from narwhal_tpu.sim.committee import deterministic_blob, run_sim_scenario
+
+    skew = {0: 250.0, 1: -250.0}
+    a = run_sim_scenario(
+        _skew_spec(), 31, str(tmp_path / "a"), clock_skew_ms=skew
+    )
+    assert all(v["ok"] for v in a["verdicts"].values()), a["verdicts"]
+    clock = a["clock"]
+    # UNCORRECTED: node 0 sees node 1 behind by the full 500 ms delta.
+    peer = clock["peer_offsets_ms"]
+    assert peer["primary-0"]["primary-1"] == pytest.approx(-500.0, abs=5.0)
+    assert peer["primary-1"]["primary-0"] == pytest.approx(500.0, abs=5.0)
+    assert peer["primary-2"]["primary-3"] == pytest.approx(0.0, abs=5.0)
+    # CORRECTED: reconciliation recovers the injected skew vector (it is
+    # zero-mean over the committee, so no common-shift ambiguity).
+    truth = {f"primary-{i}": skew.get(i, 0.0) for i in range(4)}
+    for node, want in truth.items():
+        assert clock["reconciled_ms"][node] == pytest.approx(
+            want, abs=5.0
+        ), node
+    # Residual after correction: every pairwise offset is explained by
+    # the reconciled vector.
+    for src, peers in peer.items():
+        for dst, off in peers.items():
+            residual = off - (
+                clock["reconciled_ms"][dst] - clock["reconciled_ms"][src]
+            )
+            assert residual == pytest.approx(0.0, abs=5.0), (src, dst)
+    # Straggler attribution populated and labeled by authority.
+    assert a["stragglers"]["quorum"], a["stragglers"]
+    assert all(k.startswith("primary-") for k in a["stragglers"]["quorum"])
+    # Bit-reproducible per (seed, spec): clock + stragglers ride inside
+    # the deterministic blob.
+    b = run_sim_scenario(
+        _skew_spec(), 31, str(tmp_path / "b"), clock_skew_ms=skew
+    )
+    assert deterministic_blob(a) == deterministic_blob(b)
+    assert a["clock"] == b["clock"] and a["stragglers"] == b["stragglers"]

@@ -97,6 +97,19 @@ def test_clean_local_bench_has_timeline_and_no_firing_rules(tmp_path):
             )
         with open(os.path.join(dump_dir, "flight-rings.json"), "w") as f:
             json.dump(result.flight, f, indent=1)
+        # PR 17: the skew-corrected causal sections as their own
+        # artifact — slowest committed chain, who-closed-the-quorum
+        # table, and the per-node clock corrections behind the join.
+        with open(os.path.join(dump_dir, "critical-path.json"), "w") as f:
+            json.dump(
+                {
+                    "critical_path": result.critical_path,
+                    "stragglers": result.stragglers,
+                    "clock": result.clock,
+                },
+                f,
+                indent=1,
+            )
 
     # The run itself is clean: parses, commits, cross-validates, and —
     # new gate — no node's /healthz reported a firing rule at quiesce
@@ -255,6 +268,33 @@ def test_clean_local_bench_has_timeline_and_no_firing_rules(tmp_path):
         assert "commit" in kinds, (i, sorted(kinds))
         assert "tick" in kinds, (i, sorted(kinds))
 
+    # -- skew-corrected critical path + straggler attribution (PR 17) --------
+    # A clean committed run must yield at least one digest carrying the
+    # FULL stage chain, and the slowest chain's per-leg sums must
+    # telescope to its end-to-end span within 10% — a bigger gap means a
+    # stage was dropped from STAGE_ORDER or stamped on an uncorrected
+    # clock (the join is only trustworthy when this holds).
+    cp = result.critical_path
+    assert cp.get("full_chains", 0) > 0, cp
+    path = cp["path"]
+    assert path["e2e_ms"] > 0, path
+    assert len(path["legs_ms"]) >= 5, path
+    assert abs(path["legs_sum_ms"] - path["e2e_ms"]) <= 0.10 * path[
+        "e2e_ms"
+    ] + 0.001, path
+    # Quorum stragglers: every assembled certificate charged exactly one
+    # closing voter, so the ranked table is non-empty and its addresses
+    # are committee primaries.
+    stragglers = result.stragglers
+    ranked = stragglers.get("vote_quorum") or []
+    assert ranked, stragglers
+    assert all(e["count"] > 0 for e in ranked), ranked
+    assert ranked == sorted(
+        ranked, key=lambda e: (-e["count"], e["address"])
+    ), ranked
+    gaps = stragglers.get("gaps") or {}
+    assert gaps.get("vote_quorum_gap_ms", {}).get("count", 0) > 0, gaps
+
     # -- unified Perfetto trace export (ISSUE 11 tentpole) -------------------
     # One --trace-out command round-trips the run into schema-valid
     # Chrome trace JSON: all 8 process rows and ≥1 cross-process digest
@@ -283,6 +323,17 @@ def test_clean_local_bench_has_timeline_and_no_firing_rules(tmp_path):
     assert any(c[0]["pid"] in worker_pids for c in cross), (
         "no flow starts at a worker's seal slice"
     )
+
+    # The committee-row critical-path track (PR 17): the exported trace
+    # carries the same slowest chains as ranked leg slices on a
+    # dedicated "committee" process row.
+    assert trace["metadata"]["critical_path"].get("full_chains", 0) > 0
+    cp_slices = [
+        ev for ev in trace["traceEvents"]
+        if ev["ph"] == "X" and ev.get("cat") == "critical-path"
+    ]
+    assert cp_slices, "no critical-path slices in the trace"
+    assert {ev["args"]["rank"] for ev in cp_slices} >= {1}, cp_slices
 
     # -- sampling profiler, always on (ISSUE 11 tentpole) --------------------
     # Default NARWHAL_PROFILE_HZ (~67) armed the profiler in every node:

@@ -93,7 +93,9 @@ def test_four_node_dump_round_trips_with_rows_and_flows():
     trace = trace_export.build_trace(_committee_snapshots())
     _validate_schema(trace)
 
-    # All 8 process rows, named, primaries sorted first.
+    # All 8 process rows, named, primaries sorted first — plus the
+    # PR 17 committee row carrying the critical-path track (present
+    # because the synthetic snapshots join into one full stage chain).
     names = {
         ev["args"]["name"]: ev["pid"]
         for ev in trace["traceEvents"]
@@ -102,8 +104,17 @@ def test_four_node_dump_round_trips_with_rows_and_flows():
     assert set(names) == (
         {f"primary-{i}" for i in range(4)}
         | {f"worker-{i}-0" for i in range(4)}
+        | {"committee"}
     )
+    committee_pid = names.pop("committee")
     assert names == trace["metadata"]["node_pids"]
+    cp_slices = [
+        ev for ev in trace["traceEvents"]
+        if ev["ph"] == "X" and ev["pid"] == committee_pid
+    ]
+    assert cp_slices, "committee row has no critical-path slices"
+    assert all(ev["cat"] == "critical-path" for ev in cp_slices)
+    assert trace["metadata"]["critical_path"]["full_chains"] >= 1
     assert all(names[f"primary-{i}"] < names["worker-0-0"] for i in range(4))
 
     # ≥1 cross-process digest flow: s on the sealing worker, f elsewhere.

@@ -331,9 +331,13 @@ def test_oversized_batch_rejected_uncounted_unacked():
         assert _counter("worker.malformed_frames") == m_before + 1
         assert writer.acks == [] and others_q.empty()
 
-        # An in-bounds valid batch still flows: ACK + queued.
+        # An in-bounds valid batch still flows: ACK (stamped with the
+        # sender's wall clock for the clocksync estimator) + queued.
+        from narwhal_tpu.network.clocksync import parse_ack
+
         await handler.dispatch(writer, serialized_batch())
-        assert writer.acks == [b"Ack"]
+        assert len(writer.acks) == 1
+        assert parse_ack(writer.acks[0]) is not None
         assert await asyncio.wait_for(others_q.get(), 1) == serialized_batch()
 
     asyncio.run(asyncio.wait_for(go(), 10))
