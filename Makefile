@@ -167,29 +167,35 @@ crypto-ab-smoke:
 		--min-batch-mean 0 \
 		--artifact .ci-artifacts/crypto-ab.json
 
-# Commit-rule smoke (ISSUE 15): the lowdepth rule's full validation
-# ladder in CI-affordable sizes — (a) the equivalence + flag-plumbing
-# suite (live LowDepthTusk byte-identical to its frozen oracle, classic
-# byte-identical to GoldenTusk, cross-rule checkpoint refusal, audit
-# rule markers); (b) a race-explore run with --commit-rule lowdepth:
-# 16 seeded schedules byte-identical to the NEW oracle + the socketed
-# committee replay verdicts + the planted race caught under the
-# lowdepth oracle; (c) a sim flag-flip mini-sweep (--commit-rule both):
-# every fuzzed point, control, mutation and acceptance arm under EACH
-# rule, three verdicts per arm, per-arm virtual-time cert→commit means
-# in the artifact.  The full-size flag-flip sweep (200 points) is the
+# Commit-rule smoke (ISSUE 15; ISSUE 19 adds the multileader arm): the
+# non-classic rules' full validation ladder in CI-affordable sizes —
+# (a) the equivalence + flag-plumbing suites (each live rule
+# byte-identical to ITS frozen oracle, classic byte-identical to
+# GoldenTusk, cross-rule checkpoint refusal in all six directions,
+# audit rule markers); (b) one race-explore run per non-classic rule:
+# 16 seeded schedules byte-identical to that rule's oracle + the
+# socketed committee replay verdicts + the planted race caught; (c) a
+# sim flag-flip mini-sweep (--commit-rule all): every fuzzed point,
+# control, mutation and acceptance arm under EACH of the three rules,
+# three verdicts per arm, per-arm virtual-time cert→commit means in
+# the artifact.  The full-size flag-flip sweep (200 points) is the
 # release gate run manually; this keeps every arm of it exercised per
 # push.
 commit-rule-smoke:
 	mkdir -p .ci-artifacts
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest \
-		tests/test_lowdepth_equivalence.py -x -q
+		tests/test_lowdepth_equivalence.py \
+		tests/test_multileader_equivalence.py -x -q
 	JAX_PLATFORMS=cpu $(PYTHON) benchmark/race_explore.py \
 		--seeds 16 --committee-seeds 2 --commit-rule lowdepth \
 		--workdir .race_explore_lowdepth \
 		--artifact .ci-artifacts/race-explore-lowdepth.json
+	JAX_PLATFORMS=cpu $(PYTHON) benchmark/race_explore.py \
+		--seeds 16 --committee-seeds 2 --commit-rule multileader \
+		--workdir .race_explore_multileader \
+		--artifact .ci-artifacts/race-explore-multileader.json
 	JAX_PLATFORMS=cpu $(PYTHON) benchmark/sim_bench.py \
-		--points 20 --commit-rule both --mutation-seeds 8 \
+		--points 20 --commit-rule all --mutation-seeds 8 \
 		--workdir .sim_commit_rule \
 		--artifact .ci-artifacts/sim-commit-rule-flip.json --quiet
 
@@ -236,5 +242,6 @@ bench: native
 clean:
 	$(MAKE) -C native clean
 	rm -rf .bench .bench_remote .bench_wire_ab .bench_crypto_ab \
-		.bench_commit_rule_ab .race_explore_lowdepth .sim_commit_rule \
+		.bench_commit_rule_ab .race_explore_lowdepth \
+		.race_explore_multileader .sim_commit_rule \
 		.sim_crypto_ab .sim_wire_capture .pytest_cache .ci-artifacts

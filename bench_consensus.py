@@ -64,7 +64,10 @@ from narwhal_tpu.config import (  # noqa: E402
 )
 from narwhal_tpu.crypto import KeyPair  # noqa: E402
 from narwhal_tpu.consensus.golden import GoldenTusk  # noqa: E402
-from narwhal_tpu.consensus.tusk import Tusk  # noqa: E402
+from narwhal_tpu.consensus.golden_multileader import (  # noqa: E402
+    GoldenMultiLeaderTusk,
+)
+from narwhal_tpu.consensus.tusk import MultiLeaderTusk, Tusk  # noqa: E402
 from narwhal_tpu.primary.messages import Certificate, Header, genesis  # noqa: E402
 
 
@@ -288,6 +291,90 @@ def bench_commit_burst(
     return out
 
 
+def make_ml_burst_certs(committee: Committee, rounds: int):
+    """A commit-burst stream for the MULTILEADER rule.  The classic burst
+    shape (odd rounds first) does not defer multileader commits — every
+    even round's slot anchors the moment its odd-round support quorum
+    lands — so this stream starves the quorum instead: every round is
+    delivered ascending, but each odd round ships only 2f stake of
+    certificates (one short of the 2f+1 the direct anchor needs, and
+    with zero non-support, so every slot stays UNDECIDED — never dead).
+    Nothing can commit until one trigger certificate — the withheld
+    round-(rounds-1) support cert — closes the top anchor's quorum and
+    flattens the ENTIRE slot chain in a single process_certificate
+    call."""
+    names = sorted(committee.authorities.keys())
+    quorum = committee.quorum_threshold()
+    parents = {c.digest() for c in genesis(committee)}
+    order, trigger = [], None
+    for r in range(1, rounds + 1):
+        nxt = set()
+        stake = 0
+        for name in names:
+            cert = mock_certificate(name, r, parents)
+            nxt.add(cert.digest())
+            if r % 2 == 0:
+                order.append(cert)
+            elif stake + committee.stake(name) < quorum:
+                order.append(cert)
+                stake += committee.stake(name)
+            elif trigger is None and r == rounds - 1:
+                trigger = cert  # the quorum-closing support cert
+        parents = nxt
+    return order, trigger
+
+
+def bench_commit_burst_multileader(committee: Committee, rounds: int, iters: int):
+    """The multileader commit-burst arm (ISSUE r19).  The rule commits a
+    DIFFERENT sequence than classic by design (slot anchors, cone-based
+    indirect members), so it cannot be judged against the dict_walk arm:
+    it gets its own oracle pair — the frozen naive walk
+    (``golden_multileader.py``) vs the live indexed rule — interleaved
+    exactly like the classic arms, asserted byte-identical to each
+    other."""
+    order, trigger = make_ml_burst_certs(committee, rounds)
+    gc_depth = rounds + 4
+    arms = [
+        ("ml_dict_walk", GoldenMultiLeaderTusk),
+        ("ml_indexed", MultiLeaderTusk),
+    ]
+    times = {name: [] for name, _ in arms}
+    chains = {}
+    for rep in range(max(1, iters)):
+        plan = list(arms)
+        if rep % 2:  # alternate order to cancel slow-window drift
+            plan.reverse()
+        for name, cls in plan:
+            tusk = cls(committee, gc_depth=gc_depth, fixed_coin=True)
+            for cert in order:
+                tusk.process_certificate(cert)
+            t0 = time.perf_counter()
+            seq = tusk.process_certificate(trigger)
+            times[name].append(time.perf_counter() - t0)
+            chains[name] = [bytes(x.digest()) for x in seq]
+    want = chains["ml_dict_walk"]
+    assert want, "multileader burst fixture committed nothing"
+    assert chains["ml_indexed"] == want, (
+        "multileader commit-burst sequences diverge: indexed emitted "
+        f"{len(chains['ml_indexed'])} certs vs its oracle {len(want)}"
+    )
+    return {
+        "burst_rounds": rounds,
+        "burst_committed_certs": len(want),
+        "ml_dict_walk_ms": round(
+            statistics.median(times["ml_dict_walk"]) * 1e3, 3
+        ),
+        "ml_indexed_ms": round(
+            statistics.median(times["ml_indexed"]) * 1e3, 3
+        ),
+        "ml_indexed_speedup_vs_dict": round(
+            statistics.median(times["ml_dict_walk"])
+            / statistics.median(times["ml_indexed"]),
+            2,
+        ),
+    }
+
+
 def measure_fetch_floor():
     """Fixed device round-trip floor on this host: median wall time of a
     trivial jitted compute + result fetch.  On a tunneled/remote chip this
@@ -344,6 +431,9 @@ def main() -> None:
             KernelTusk, committee, args.burst_rounds, args.burst_iters,
             floor_s,
         )
+        ml_burst = bench_commit_burst_multileader(
+            committee, args.burst_rounds, args.burst_iters
+        )
         pair = bench_pair(
             KernelTusk, committee, args.span, args.iters, args.build_reps
         )
@@ -386,6 +476,10 @@ def main() -> None:
             # indexed walk (vs the kernel's catch-up flush) on one
             # trigger committing the whole chain.
             "commit_burst": burst,
+            # Multileader burst (ISSUE r19): the live multileader rule vs
+            # ITS frozen oracle — the sequences differ from classic by
+            # design, so this arm pair is judged internally.
+            "commit_burst_multileader": ml_burst,
         }
         results.append(row)
         print(json.dumps(row))
@@ -408,6 +502,14 @@ def main() -> None:
         # the multi-leader burst at committee sizes ≥ 20.
         "indexed_burst_speedup_ge2_at_n_ge_20": all(
             r["commit_burst"]["indexed_speedup_vs_dict"] >= 2
+            for r in results
+            if r["committee"] >= 20
+        ),
+        # ISSUE r19 gate: the live multileader rule at least doubles ITS
+        # frozen oracle on the slot-chain burst at committee sizes ≥ 20
+        # (byte-identity to that oracle is asserted inside the arm).
+        "multileader_burst_speedup_ge2_at_n_ge_20": all(
+            r["commit_burst_multileader"]["ml_indexed_speedup_vs_dict"] >= 2
             for r in results
             if r["committee"] >= 20
         ),

@@ -1,8 +1,12 @@
-"""Paired interleaved commit-rule A/B: classic Tusk vs the lowdepth rule
-(ROADMAP item 2, the r10/r19 A/B methodology).
+"""Paired interleaved commit-rule A/B: classic Tusk vs a challenger rule
+(ROADMAP item 2, the r10/r19 A/B methodology; r19 generalizes the
+challenger arm).
 
 Arms differ ONLY in ``NARWHAL_COMMIT_RULE`` — same committee shape, same
-rate, same wire/crypto planes:
+rate, same wire/crypto planes — except that the challenger arm may also
+carry ``--header-linger`` (the multileader rule's proposer-side knob;
+classic never reads it, so giving it to classic would only add latency
+noise to the baseline):
 
 - **classic** — Tusk: the round-L leader commits at depth 3 (a
   round-(L+3) certificate triggers, f+1 round-(L+1) support).
@@ -10,27 +14,30 @@ rate, same wire/crypto planes:
   moment 2f+1 round-(L+1) certificates cite it (depth 1 on the leader,
   ~2 averaged over the flattened window), judged against its own frozen
   oracle everywhere else in the tree.
+- **multileader** — Mysticeti multi-slot: 3 round-salted leader slots
+  per even round, the commit anchors on the lowest 2f+1-supported slot;
+  its own frozen oracle (``consensus/golden_multileader.py``).
 
-Arms are interleaved (classic, lowdepth, classic, ...) so slow host
+Arms are interleaved (classic, challenger, classic, ...) so slow host
 drift hits both equally.  The target series is the ``cert_to_commit``
 stage leg from the bench JSON (the PR 4 sub-stage attribution measured
 it 97-98% protocol cadence — commit depth × round period — which is
 exactly what a lower commit depth cuts).  Gates:
 
 - zero run errors on BOTH arms;
-- lowdepth median committed TPS within ``--tps-tolerance`` of classic
+- challenger median committed TPS within ``--tps-tolerance`` of classic
   (the latency cut must come at EQUAL throughput);
-- classic/lowdepth median ``cert_to_commit`` ratio ≥ ``--min-speedup``
+- classic/challenger median ``cert_to_commit`` ratio ≥ ``--min-speedup``
   (default 1.6, the "~2×" claim with room for the non-leader tail) —
   on a drifting shared-core host record WHY with ``--verdict-note``
   (the r06/r19 honest-verdict precedent) instead of deleting the gate.
 
-Artifact keys are ``classic_runs``/``lowdepth_runs`` — deliberately NOT
-``runs`` so benchmark/trajectory.py does not read a fixed-rate A/B as a
-saturation-series point.
+Artifact keys are ``classic_runs``/``<challenger>_runs`` — deliberately
+NOT ``runs`` so benchmark/trajectory.py does not read a fixed-rate A/B
+as a saturation-series point.
 
     python benchmark/commit_rule_ab.py --pairs 3 --duration 15 \
-        --artifact artifacts/commit_rule_ab_r20.json
+        --challenger multileader --artifact artifacts/commit_rule_ab_r23.json
 """
 
 from __future__ import annotations
@@ -69,6 +76,7 @@ def _one_run(arm: str, idx: int, args) -> dict:
         quiet=True,
         progress_wait=args.progress_wait,
         commit_rule=arm,
+        header_linger=(args.header_linger if arm == args.challenger else 0),
     )
     stages = result.stages_ms or {}
     return {
@@ -102,13 +110,24 @@ def main(argv=None) -> int:
     ap.add_argument("--base-port", type=int, default=7600)
     ap.add_argument("--progress-wait", type=float, default=30.0)
     ap.add_argument(
+        "--challenger", choices=["lowdepth", "multileader"],
+        default="lowdepth",
+        help="The non-classic arm of the pair",
+    )
+    ap.add_argument(
+        "--header-linger", type=int, default=0,
+        help="header_linger (ms) for the CHALLENGER arm only — the "
+        "multileader rule's proposer knob; classic ignores it, so the "
+        "baseline stays the shipped default",
+    )
+    ap.add_argument(
         "--min-speedup", type=float, default=1.6,
-        help="Required classic/lowdepth median cert_to_commit ratio "
+        help="Required classic/challenger median cert_to_commit ratio "
         "(the ~2× claim with room for the non-leader tail)",
     )
     ap.add_argument(
         "--tps-tolerance", type=float, default=0.25,
-        help="Lowdepth median committed TPS may be at most this "
+        help="Challenger median committed TPS may be at most this "
         "fraction below classic (shared-core noise floor)",
     )
     ap.add_argument(
@@ -119,10 +138,11 @@ def main(argv=None) -> int:
     )
     ap.add_argument("--artifact", default="artifacts/commit_rule_ab_r20.json")
     args = ap.parse_args(argv)
+    challenger = args.challenger
 
-    runs = {"classic": [], "lowdepth": []}
+    runs = {"classic": [], challenger: []}
     for i in range(args.pairs):
-        for arm in ("classic", "lowdepth"):
+        for arm in ("classic", challenger):
             print(f"== commit-rule A/B pair {i + 1}/{args.pairs}: {arm} ==")
             r = _one_run(arm, i, args)
             runs[arm].append(r)
@@ -133,44 +153,47 @@ def main(argv=None) -> int:
             )
 
     failures = []
-    for r in runs["classic"] + runs["lowdepth"]:
+    for r in runs["classic"] + runs[challenger]:
         if r["errors"]:
             failures.append(f"{r['arm']} run {r['run']}: {r['errors'][:3]}")
 
     c2c_classic = _median(
         [r["cert_to_commit_ms"] for r in runs["classic"]]
     )
-    c2c_lowdepth = _median(
-        [r["cert_to_commit_ms"] for r in runs["lowdepth"]]
+    c2c_challenger = _median(
+        [r["cert_to_commit_ms"] for r in runs[challenger]]
     )
     tps_classic = _median([r["consensus_tps"] for r in runs["classic"]])
-    tps_lowdepth = _median([r["consensus_tps"] for r in runs["lowdepth"]])
+    tps_challenger = _median([r["consensus_tps"] for r in runs[challenger]])
     speedup = None
-    if c2c_classic is None or c2c_lowdepth is None:
+    if c2c_classic is None or c2c_challenger is None:
         failures.append("cert_to_commit missing from an arm's stage trace")
     else:
-        speedup = round(c2c_classic / c2c_lowdepth, 3)
+        speedup = round(c2c_classic / c2c_challenger, 3)
         if speedup < args.min_speedup:
             failures.append(
                 f"cert_to_commit speedup {speedup}x < required "
                 f"{args.min_speedup}x (classic {c2c_classic} ms, "
-                f"lowdepth {c2c_lowdepth} ms)"
+                f"{challenger} {c2c_challenger} ms)"
             )
-    if tps_classic and tps_lowdepth is not None and (
-        tps_lowdepth < tps_classic * (1 - args.tps_tolerance)
+    if tps_classic and tps_challenger is not None and (
+        tps_challenger < tps_classic * (1 - args.tps_tolerance)
     ):
         failures.append(
-            f"lowdepth median committed TPS {tps_lowdepth:,.0f} more than "
-            f"{args.tps_tolerance:.0%} below classic {tps_classic:,.0f}"
+            f"{challenger} median committed TPS {tps_challenger:,.0f} more "
+            f"than {args.tps_tolerance:.0%} below classic "
+            f"{tps_classic:,.0f}"
         )
 
     summary = {
+        "challenger": challenger,
+        "header_linger_ms": args.header_linger,
         "cert_to_commit_ms": {
-            "classic": c2c_classic, "lowdepth": c2c_lowdepth,
+            "classic": c2c_classic, challenger: c2c_challenger,
         },
         "speedup": speedup,
         "consensus_tps": {
-            "classic": tps_classic, "lowdepth": tps_lowdepth,
+            "classic": tps_classic, challenger: tps_challenger,
         },
         "consensus_latency_ms": {
             arm: _median([r["consensus_latency_ms"] for r in arm_runs])
@@ -188,14 +211,19 @@ def main(argv=None) -> int:
 
     artifact = {
         "what": (
-            "Paired interleaved commit-rule A/B (ISSUE 15): classic Tusk "
-            "vs the lowdepth (Mysticeti-style direct-commit) rule on a "
-            f"{args.nodes}-node local_bench, rate {args.rate}, "
-            f"{args.tx_size} B tx, {args.duration} s windows; arms "
-            "differ only in NARWHAL_COMMIT_RULE."
+            "Paired interleaved commit-rule A/B: classic Tusk vs the "
+            f"{challenger} rule on a {args.nodes}-node local_bench, rate "
+            f"{args.rate}, {args.tx_size} B tx, {args.duration} s "
+            "windows; arms differ only in NARWHAL_COMMIT_RULE"
+            + (
+                f" plus header_linger={args.header_linger} ms on the "
+                "challenger arm."
+                if args.header_linger
+                else "."
+            )
         ),
         "classic_runs": runs["classic"],
-        "lowdepth_runs": runs["lowdepth"],
+        f"{challenger}_runs": runs[challenger],
         "summary": summary,
     }
     if args.verdict_note:
@@ -211,8 +239,8 @@ def main(argv=None) -> int:
         return 1
     print(
         f"commit-rule A/B ok: cert_to_commit {c2c_classic} -> "
-        f"{c2c_lowdepth} ms ({speedup}x) at committed TPS "
-        f"{tps_classic:,.0f} -> {tps_lowdepth:,.0f}"
+        f"{c2c_challenger} ms ({speedup}x) at committed TPS "
+        f"{tps_classic:,.0f} -> {tps_challenger:,.0f}"
     )
     return 0
 

@@ -193,6 +193,7 @@ def run_bench(
     batch_size: int = 500_000,
     max_header_delay: int = 100,
     min_header_delay: int = 0,
+    header_linger: int = 0,
     max_batch_delay: int = 100,
     workdir: str = None,
     keep_logs: bool = False,
@@ -246,6 +247,7 @@ def run_bench(
         batch_size=batch_size,
         max_header_delay=max_header_delay,
         min_header_delay=min_header_delay,
+        header_linger=header_linger,
         max_batch_delay=max_batch_delay,
     )
     params.export(f"{workdir}/parameters.json")
@@ -591,6 +593,15 @@ def main():
         "payload proposes after this delay instead of riding "
         "--max-header-delay; 0 = reference behavior",
     )
+    parser.add_argument(
+        "--header-linger",
+        type=int,
+        default=0,
+        help="Parent-linger window (ms): a just-advanced round holds its "
+        "header open this long so post-quorum parent certificates are "
+        "still cited — the proposer half of the multileader commit "
+        "rule; 0 = reference behavior",
+    )
     parser.add_argument("--max-header-delay", type=int, default=100)
     parser.add_argument("--json", action="store_true")
     parser.add_argument(
@@ -624,11 +635,15 @@ def main():
         "unset inherits the environment (default off)",
     )
     parser.add_argument(
-        "--commit-rule", choices=["classic", "lowdepth"], default=None,
+        "--commit-rule",
+        choices=["classic", "lowdepth", "multileader"],
+        default=None,
         help="Consensus commit rule for the whole committee "
         "(NARWHAL_COMMIT_RULE): classic = Tusk depth-3 commits, "
         "lowdepth = Mysticeti-style direct commits one round after the "
-        "leader; unset inherits the environment (default classic)",
+        "leader, multileader = 3 leader slots per even round anchoring "
+        "on the lowest supported slot; unset inherits the environment "
+        "(default classic)",
     )
     parser.add_argument(
         "--experimental-consensus-kernel",
@@ -656,6 +671,7 @@ def main():
         faults=args.faults,
         base_port=args.base_port,
         min_header_delay=args.min_header_delay,
+        header_linger=args.header_linger,
         max_header_delay=args.max_header_delay,
         crypto_backend=args.crypto_backend,
         consensus_kernel=args.consensus_kernel,

@@ -41,6 +41,7 @@ _CONFIG_PATTERNS = [
     ("header_size", r"Header size set to (\d+) B"),
     ("max_header_delay", r"Max header delay set to (\d+) ms"),
     ("min_header_delay", r"Min header delay set to (\d+) ms"),
+    ("header_linger", r"Header linger set to (\d+) ms"),
     ("gc_depth", r"Garbage collection depth set to (\d+) rounds"),
     ("sync_retry_delay", r"Sync retry delay set to (\d+) ms"),
     ("sync_retry_nodes", r"Sync retry nodes set to (\d+) nodes"),

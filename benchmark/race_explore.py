@@ -71,6 +71,9 @@ from narwhal_tpu.consensus.golden import GoldenTusk  # noqa: E402
 from narwhal_tpu.consensus.golden_lowdepth import (  # noqa: E402
     GoldenLowDepthTusk,
 )
+from narwhal_tpu.consensus.golden_multileader import (  # noqa: E402
+    GoldenMultiLeaderTusk,
+)
 from narwhal_tpu.consensus.replay import (  # noqa: E402
     cross_node_prefix,
     replay_segments,
@@ -159,7 +162,10 @@ def build_stream(committee: Committee) -> List[Certificate]:
 def golden_sequence(
     committee: Committee, stream: List[Certificate], rule: str = "classic"
 ) -> List[bytes]:
-    oracle_cls = GoldenLowDepthTusk if rule == "lowdepth" else GoldenTusk
+    oracle_cls = {
+        "lowdepth": GoldenLowDepthTusk,
+        "multileader": GoldenMultiLeaderTusk,
+    }.get(rule, GoldenTusk)
     golden = oracle_cls(committee, GC_DEPTH, fixed_coin=False)
     out: List[bytes] = []
     for cert in stream:
@@ -486,11 +492,13 @@ def main(argv=None) -> int:
     ap.add_argument("--committee-seeds", type=int, default=4,
                     help="socketed committee-scenario seed count")
     ap.add_argument(
-        "--commit-rule", choices=["classic", "lowdepth"], default="classic",
+        "--commit-rule",
+        choices=["classic", "lowdepth", "multileader"],
+        default="classic",
         help="Judge every arm against this commit rule's oracle and run "
-        "the committee/pipeline Consensus under it — the lowdepth rule "
-        "must survive the same ≥16-seed schedule exploration against "
-        "ITS golden walk before it can ship (ROADMAP item 2)",
+        "the committee/pipeline Consensus under it — every non-classic "
+        "rule must survive the same ≥16-seed schedule exploration "
+        "against ITS golden walk before it can ship (ROADMAP item 2)",
     )
     ap.add_argument("--skip-mutation", action="store_true")
     ap.add_argument("--artifact", default=None)

@@ -131,16 +131,18 @@ def run_sweep(args) -> int:
     sizes_seen: set = set()
     # (obj, run_seed, blob) per arm for the determinism pin.
     first: Dict[str, tuple] = {}
-    # classic | lowdepth | both — `both` is the commit-rule FLAG-FLIP
+    # Single rule | both | all — `both` is the commit-rule FLAG-FLIP
     # sweep (ROADMAP item 2): every fuzzed point runs under each rule,
     # each arm judged by all three verdicts (safety against the arm's
     # own frozen oracle via the audit rule marker), and the virtual-time
-    # cert→commit means price the latency claim per arm.
-    arms = (
-        ["classic", "lowdepth"]
-        if args.commit_rule == "both"
-        else [args.commit_rule or "classic"]
-    )
+    # cert→commit means price the latency claim per arm.  `all` adds the
+    # multileader arm (ISSUE r19) on top of the original pair.
+    if args.commit_rule == "both":
+        arms = ["classic", "lowdepth"]
+    elif args.commit_rule == "all":
+        arms = ["classic", "lowdepth", "multileader"]
+    else:
+        arms = [args.commit_rule or "classic"]
 
     # -- the sweep -------------------------------------------------------------
     specs = []
@@ -375,16 +377,17 @@ def run_sweep(args) -> int:
                 round(total_s / total_n, 6) if total_n else None
             ),
         }
-    if (
-        len(arms) == 2
-        and latency["classic"]["mean_virtual_s"]
-        and latency["lowdepth"]["mean_virtual_s"]
-    ):
-        latency["classic_over_lowdepth"] = round(
-            latency["classic"]["mean_virtual_s"]
-            / latency["lowdepth"]["mean_virtual_s"],
-            3,
-        )
+    if len(arms) > 1 and latency.get("classic", {}).get("mean_virtual_s"):
+        # One speedup ratio per non-classic arm; >1.0 means the arm
+        # commits faster than classic in virtual time.
+        for arm in arms:
+            if arm == "classic" or not latency[arm]["mean_virtual_s"]:
+                continue
+            latency[f"classic_over_{arm}"] = round(
+                latency["classic"]["mean_virtual_s"]
+                / latency[arm]["mean_virtual_s"],
+                3,
+            )
     if not args.quiet and latency:
         print(f"[latency] {json.dumps(latency)}")
 
@@ -565,11 +568,15 @@ def run_replay(args) -> int:
     # Explicit --commit-rule wins; else the rule RECORDED in the repro
     # (the arm that failed); else the resolver default.  `both` is a
     # sweep concept, not a single replay's.
-    rule = None if args.commit_rule == "both" else args.commit_rule
+    rule = (
+        None if args.commit_rule in ("both", "all") else args.commit_rule
+    )
     if "spec" in obj and isinstance(obj["spec"], dict):
         if run_seed is None and "run_seed" in obj:
             run_seed = int(obj["run_seed"])
-        if rule is None and obj.get("commit_rule") in ("classic", "lowdepth"):
+        if rule is None and obj.get("commit_rule") in (
+            "classic", "lowdepth", "multileader",
+        ):
             rule = obj["commit_rule"]
         obj = obj["spec"]
     scenario = parse_scenario(obj, env={})
@@ -596,13 +603,15 @@ def main(argv=None) -> int:
     ap.add_argument("--mutation-seeds", type=int, default=12,
                     help="max schedules to try for the racy arm")
     ap.add_argument(
-        "--commit-rule", choices=["classic", "lowdepth", "both"],
+        "--commit-rule",
+        choices=["classic", "lowdepth", "multileader", "both", "all"],
         default=None,
-        help="Commit rule for every committee in the sweep; `both` is "
-        "the flag-flip sweep — every fuzzed point, control, mutation and "
-        "acceptance arm runs under EACH rule, safety judged against the "
-        "arm's own frozen oracle, with per-arm virtual-time cert→commit "
-        "means pricing the latency claim (ROADMAP item 2)",
+        help="Commit rule for every committee in the sweep; `both` "
+        "(classic+lowdepth) and `all` (classic+lowdepth+multileader) "
+        "are the flag-flip sweeps — every fuzzed point, control, "
+        "mutation and acceptance arm runs under EACH rule, safety "
+        "judged against the arm's own frozen oracle, with per-arm "
+        "virtual-time cert→commit means pricing the latency claim",
     )
     ap.add_argument("--skip-mutation", action="store_true")
     ap.add_argument("--skip-acceptance", action="store_true")

@@ -401,8 +401,18 @@ def _emit_flight(b, pid, ring: dict, t0) -> None:
                 b.counter_track(pid, "flight ticks", _us(t, t0), vals)
             continue
         args = {k: v for k, v in ev.items() if k not in ("t", "kind")}
-        b.instant(pid, TID_EVENTS, f"flight:{kind}", _us(t, t0),
-                  "flight", args or None)
+        name = f"flight:{kind}"
+        # Multileader commits carry their anchor coordinates (which slot
+        # of which even round anchored, plus the round's full slot
+        # schedule in `slots`): put slot@round in the instant NAME so a
+        # missed-slot round reads directly off the timeline — the args
+        # still hold the schedule for the click-through detail.
+        if kind == "commit" and "anchor_slot" in args:
+            name = (
+                f"flight:commit[slot{args['anchor_slot']}"
+                f"@r{args.get('anchor_round', '?')}]"
+            )
+        b.instant(pid, TID_EVENTS, name, _us(t, t0), "flight", args or None)
 
 
 def _emit_profile(b, pid, snap, t0) -> None:

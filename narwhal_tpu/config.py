@@ -193,6 +193,19 @@ class Parameters:
     # spin headers at wire speed).  0 (the default) disables the fast
     # cadence and keeps the reference behavior bit-for-bit.
     min_header_delay: int = 0
+    # Parent-linger window: when > 0, a proposer whose round just advanced
+    # holds the next header open for this many milliseconds so parent
+    # certificates arriving AFTER the round-advance quorum still get cited
+    # (the Core forwards post-quorum certificates while the window is
+    # open).  Without it a header's parents are exactly the FIRST 2f+1
+    # certificates of the round, which leaves commit-rule slot support
+    # sitting at the quorum borderline (the multileader rule's motivating
+    # measurement — see consensus/tusk.py::MultiLeaderTusk).  Price it off
+    # the measured consensus.support_arrival_ms headroom: a linger of
+    # roughly that spread converts borderline support rounds into direct
+    # commits.  max_header_delay still caps every round; 0 (the default)
+    # disables the window and keeps the reference behavior bit-for-bit.
+    header_linger: int = 0
     # Depth of garbage collection, in rounds.
     gc_depth: int = 50
     # Delay before retrying a sync request, and fan-out of the retry.
@@ -208,6 +221,7 @@ class Parameters:
         logger.info("Header size set to %s B", self.header_size)
         logger.info("Max header delay set to %s ms", self.max_header_delay)
         logger.info("Min header delay set to %s ms", self.min_header_delay)
+        logger.info("Header linger set to %s ms", self.header_linger)
         logger.info("Garbage collection depth set to %s rounds", self.gc_depth)
         logger.info("Sync retry delay set to %s ms", self.sync_retry_delay)
         logger.info("Sync retry nodes set to %s nodes", self.sync_retry_nodes)
@@ -219,6 +233,7 @@ class Parameters:
             "header_size": self.header_size,
             "max_header_delay": self.max_header_delay,
             "min_header_delay": self.min_header_delay,
+            "header_linger": self.header_linger,
             "gc_depth": self.gc_depth,
             "sync_retry_delay": self.sync_retry_delay,
             "sync_retry_nodes": self.sync_retry_nodes,

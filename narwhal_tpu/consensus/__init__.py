@@ -3,8 +3,10 @@ from .tusk import (
     CheckpointRuleMismatch,
     Consensus,
     LowDepthTusk,
+    MultiLeaderTusk,
     State,
     Tusk,
+    leader_slots,
     resolve_commit_rule,
 )
 
@@ -13,7 +15,9 @@ __all__ = [
     "CheckpointRuleMismatch",
     "Consensus",
     "LowDepthTusk",
+    "MultiLeaderTusk",
     "State",
     "Tusk",
+    "leader_slots",
     "resolve_commit_rule",
 ]

@@ -110,9 +110,10 @@ _LEN = struct.Struct("<I")
 TAG_RESTORE = b"R"
 TAG_INSERT = b"I"
 TAG_COMMIT = b"C"
-# Commit-rule marker ('classic' | 'lowdepth'), written immediately after
-# the restore marker.  Segments recorded before the marker existed have
-# none and replay under the classic oracle — exactly what recorded them.
+# Commit-rule marker ('classic' | 'lowdepth' | 'multileader'), written
+# immediately after the restore marker.  Segments recorded before the
+# marker existed have none and replay under the classic oracle — exactly
+# what recorded them.
 TAG_RULE = b"M"
 
 _RULE_ORACLES = {"classic": GoldenTusk}
@@ -120,10 +121,14 @@ _RULE_ORACLES = {"classic": GoldenTusk}
 
 def _oracle_for(rule: str):
     if rule == "lowdepth":
-        # Deferred: the classic-only paths never import the second oracle.
+        # Deferred: the classic-only paths never import the other oracles.
         from .golden_lowdepth import GoldenLowDepthTusk
 
         return GoldenLowDepthTusk
+    if rule == "multileader":
+        from .golden_multileader import GoldenMultiLeaderTusk
+
+        return GoldenMultiLeaderTusk
     return _RULE_ORACLES[rule]
 
 
@@ -251,7 +256,7 @@ def replay_segments(
         body = records[1:]
         if body and body[0][0] == TAG_RULE:
             raw = body[0][1].decode("ascii", "replace")
-            if raw not in ("classic", "lowdepth"):
+            if raw not in ("classic", "lowdepth", "multileader"):
                 violations.append(
                     f"segment {seg_i}: unknown commit-rule marker {raw!r}"
                 )

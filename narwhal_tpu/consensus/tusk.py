@@ -41,6 +41,7 @@ multi-leader / gc-wrap / checkpoint-restore streams
 from __future__ import annotations
 
 import asyncio
+import hashlib
 import logging
 import os
 import struct
@@ -62,14 +63,62 @@ Dag = Dict[Round, Dict[PublicKey, Tuple[Digest, Certificate]]]
 
 # The selectable commit rules (NARWHAL_COMMIT_RULE / `node run
 # --commit-rule`) and the checkpoint magic each writes.  A frontier
-# snapshot is only meaningful to the rule that produced it — the two
-# rules commit at different depths, so one rule's frontier restored
-# under the other would anchor the walk at rounds the other rule never
-# decided.  Distinct magics turn that operator error into a LOUD
-# boot-time refusal (CheckpointRuleMismatch) instead of a silent
-# reinterpretation.
-COMMIT_RULES = ("classic", "lowdepth")
-RULE_MAGICS = {"classic": b"NCKPT1", "lowdepth": b"NCKLD1"}
+# snapshot is only meaningful to the rule that produced it — the rules
+# commit at different depths (and multileader anchors different
+# authorities entirely), so one rule's frontier restored under another
+# would anchor the walk at rounds that rule never decided.  Distinct
+# magics turn that operator error into a LOUD boot-time refusal
+# (CheckpointRuleMismatch) instead of a silent reinterpretation.
+COMMIT_RULES = ("classic", "lowdepth", "multileader")
+RULE_MAGICS = {
+    "classic": b"NCKPT1",
+    "lowdepth": b"NCKLD1",
+    "multileader": b"NCKML1",
+}
+
+# Leader slots per even round under the multileader rule.  A pure
+# constant (not an env knob): the slot schedule feeds the frozen golden
+# oracle and the audit replay judge, so a run-time knob would let a
+# replay silently judge a recording against a different schedule.
+MULTILEADER_SLOTS = 3
+
+
+def leader_slots(
+    sorted_keys: List[PublicKey],
+    round_: Round,
+    k: Optional[int] = None,
+    fixed_coin: bool = False,
+) -> List[PublicKey]:
+    """The K leader-slot authorities for an even round, in slot order.
+
+    Deterministic pure function of (sorted committee keys, round) — the
+    schedule must be identical across processes and restarts because
+    every node's commit decisions and the frozen oracle's replay both
+    derive it independently.  Slot 0 ROTATES (``(round // 2) % n``), so
+    over any ``committee_size`` consecutive even rounds every authority
+    holds slot 0 exactly once — no authority monopolizes the anchor
+    slot, and none is starved of it for longer than one full rotation.
+    The remaining slots are a round-salted rotation of the rest of the
+    committee (SHA-256 of the round number), so the backup slots are
+    not permanently the rotation's next-in-line either.
+
+    ``fixed_coin`` pins the schedule to the first K sorted authorities —
+    the multileader analogue of the reference's ``#[cfg(test)] coin = 0``
+    used by the golden tests."""
+    n = len(sorted_keys)
+    k = min(n, MULTILEADER_SLOTS if k is None else k)
+    if fixed_coin:
+        return list(sorted_keys[:k])
+    base = (round_ // 2) % n
+    order = [sorted_keys[(base + j) % n] for j in range(n)]
+    head, rest = order[0], order[1:]
+    if len(rest) > 1:
+        salt = int.from_bytes(
+            hashlib.sha256(struct.pack("<Q", round_)).digest()[:8], "little"
+        )
+        off = salt % len(rest)
+        rest = rest[off:] + rest[:off]
+    return [head] + rest[: k - 1]
 
 
 class CheckpointRuleMismatch(ValueError):
@@ -242,6 +291,14 @@ class LowDepthState(State):
 
     _CKPT_MAGIC = RULE_MAGICS["lowdepth"]
     commit_rule = "lowdepth"
+
+
+class MultiLeaderState(State):
+    """State for the multi-leader rule: identical structure, its own
+    checkpoint magic (rationale at RULE_MAGICS)."""
+
+    _CKPT_MAGIC = RULE_MAGICS["multileader"]
+    commit_rule = "multileader"
 
 
 class Tusk:
@@ -578,6 +635,292 @@ class LowDepthTusk(Tusk):
         return sequence
 
 
+class MultiLeaderTusk(Tusk):
+    """Mysticeti-style multi-leader commit rule (arXiv:2310.14821 §4,
+    "multiple leaders per round"), layered on the indexed state.
+
+    One leader per even round leaves the commit cadence hostage to one
+    validator's support-arrival luck: the lowdepth rule's 2.05× win at
+    N=4 collapses to ~1.0–1.3× at N=10/20 because a header's parents
+    are exactly the FIRST 2f+1 certificates of the round (the
+    round-advance quorum), so each round-(L+1) certificate cites the
+    round-L leader with probability ≈ 2/3 and the leader's direct
+    support hovers AT the quorum line (artifacts/commit_rule_ab_r20.json
+    caveat).  This rule gives every even round K = ``MULTILEADER_SLOTS``
+    leader slots (schedule: :func:`leader_slots`) so any supported slot
+    can anchor the round's commit, and pairs with the Proposer's
+    ``header_linger_ms`` knob, which widens parent sets past the bare
+    quorum so slot support stops being borderline.
+
+    Decision rules (all pure functions of the DAG, which is what makes
+    the commit sequence a cross-node-consistent prefix — the same
+    property the other two rules lean on):
+
+    - **direct support**: stake of round-(L+1) certificates citing slot
+      s's leader digest, accumulated INCREMENTALLY per (round, slot) at
+      insert time — the per-leader counters of the classic rule,
+      extended per-slot.
+    - **dead slot**: ≥ 2f+1 stake of round-(L+1) certificates exist
+      that do NOT cite the slot leader.  Final and view-independent: at
+      most f stake of child certificates remain unseen, so the slot's
+      support can never reach 2f+1 anywhere.
+    - **direct anchor**: the commit scan walks slots 0..K-1 in order
+      and anchors on the LOWEST slot whose support reaches 2f+1, but
+      only if every lower slot is dead — a lower slot that is merely
+      *undecided* (neither 2f+1 support nor 2f+1 non-support yet) could
+      still anchor on another node, so acting past it would fork the
+      sequence.  Two nodes that direct-anchor the same round therefore
+      anchor the SAME slot: slot s anchoring here means every lower
+      slot has ≤ f support, while slot t < s anchoring elsewhere would
+      need 2f+1 — impossible in one 3f+1-stake child round.
+    - **indirect (chain walk)**: while descending the committed chain,
+      the member for even round r is the first slot whose leader has
+      f+1 stake of supporters INSIDE the walk frontier (the causal cone
+      of the nearest committed anchor above — Mysticeti's "indirect
+      decision via the first committed anchor", which is what makes it
+      identical on every node).  A direct-anchored slot always
+      re-derives: its 2f+1 supporters intersect the ≥ 2f+1-stake cone
+      at every round in f+1 stake, while dead lower slots (≤ f global
+      support) can never reach f+1 cone support.
+
+    The anchor's causal sub-DAG is ordered exactly as today: the
+    inherited ``order_dag`` flatten, ``note_committed`` frontier
+    advance, and one ``State.gc`` sweep per burst.  Commit sequences
+    DIFFER from both other rules by design, so this rule is judged
+    against its own frozen oracle (``consensus/golden_multileader.py``);
+    checkpoints carry the ``NCKML1`` magic and refuse a cross-rule
+    restore."""
+
+    STATE_CLS = MultiLeaderState
+    commit_rule = "multileader"
+
+    def __init__(
+        self, committee: Committee, gc_depth: Round, fixed_coin: bool = False
+    ) -> None:
+        super().__init__(committee, gc_depth, fixed_coin=fixed_coin)
+        # (even leader round, slot) → accumulated stake of round+1
+        # certificates citing that slot leader's digest.  The base
+        # class's single-leader ``_support`` dict stays empty (this
+        # class overrides both maintenance points).
+        self._slot_support: Dict[Tuple[Round, int], int] = {}
+        # even leader round → accumulated stake of round+1 certificates
+        # present at all (the denominator of the dead-slot rule).
+        self._child_stake: Dict[Round, int] = {}
+        # round → slot schedule; rebuilt on demand, pruned with the
+        # counters (one SHA-256 per round otherwise recomputed per
+        # child-certificate insert).
+        self._slot_cache: Dict[Round, List[PublicKey]] = {}
+        # (leader_round, anchor_slot) of the most recent direct anchor —
+        # the runner annotates the commit flight event with it so a
+        # missed-slot round is readable on the Perfetto timeline.
+        self.last_anchor: Optional[Tuple[Round, int]] = None
+
+    def _slots(self, round_: Round) -> List[PublicKey]:
+        slots = self._slot_cache.get(round_)
+        if slots is None:
+            slots = leader_slots(
+                self._sorted_keys, round_, fixed_coin=self.fixed_coin
+            )
+            self._slot_cache[round_] = slots
+        return slots
+
+    def insert_certificate(self, certificate: Certificate) -> None:
+        d, prev = self.state.insert(certificate)
+        if prev is not None and prev == d:
+            return  # idempotent re-insert: counters already reflect it
+        r = certificate.round
+        dag = self.state.dag
+        if prev is None:
+            if r % 2 == 1 and r >= 3:
+                # Fresh child certificate: count it once toward the
+                # round's child stake, and toward every slot leader it
+                # cites — the classic incremental bump, per slot.
+                stake = self.committee.stake(certificate.origin)
+                self._child_stake[r - 1] = (
+                    self._child_stake.get(r - 1, 0) + stake
+                )
+                slot_row = dag.get(r - 1, {})
+                parents = certificate.header.parents
+                for s, name in enumerate(self._slots(r - 1)):
+                    got = slot_row.get(name)
+                    if got is not None and got[0] in parents:
+                        old = self._slot_support.get((r - 1, s), 0)
+                        new = old + stake
+                        self._slot_support[(r - 1, s)] = new
+                        if s == 0 and self.support_observer is not None:
+                            # Slot 0 is the round's primary anchor slot:
+                            # its quorum spread is what
+                            # consensus.support_arrival_ms prices, same
+                            # clock and semantics as the other rules.
+                            self.support_observer(
+                                r - 1, old, new, certificate.origin
+                            )
+            elif r % 2 == 0 and r >= 2 and certificate.origin in set(
+                self._slots(r)
+            ):
+                # A slot leader arrived (possibly after some of its
+                # supporters): seed its counter from the children
+                # already present.
+                self._recompute_slot_support(r)
+        else:
+            # Equivocation overwrite: recompute the affected round
+            # exactly (rare and adversarial, same policy as the base).
+            if r % 2 == 1 and r >= 3:
+                self._recompute_slot_support(r - 1)
+            elif r % 2 == 0 and r >= 2 and certificate.origin in set(
+                self._slots(r)
+            ):
+                self._recompute_slot_support(r)
+
+    def _recompute_slot_support(self, leader_round: Round) -> None:
+        """From-scratch per-slot support and child stake for one leader
+        round (cold paths only: a slot leader arriving after supporters,
+        or an equivocation overwrite)."""
+        dag = self.state.dag
+        slot_row = dag.get(leader_round, {})
+        children = dag.get(leader_round + 1, {}).values()
+        stakes = [
+            (self.committee.stake(cert.origin), cert.header.parents)
+            for _, cert in children
+        ]
+        self._child_stake[leader_round] = sum(s for s, _ in stakes)
+        for s, name in enumerate(self._slots(leader_round)):
+            got = slot_row.get(name)
+            if got is None:
+                self._slot_support.pop((leader_round, s), None)
+                continue
+            digest = got[0]
+            self._slot_support[(leader_round, s)] = sum(
+                stake for stake, parents in stakes if digest in parents
+            )
+
+    def _direct_anchor(
+        self, leader_round: Round
+    ) -> Optional[Tuple[Certificate, int]]:
+        """Slot-ordered anchor scan: the lowest slot with 2f+1 direct
+        support, provided every lower slot is provably dead (class
+        docstring).  Returns (anchor certificate, slot) or None."""
+        quorum = self.committee.quorum_threshold()
+        child_stake = self._child_stake.get(leader_round, 0)
+        slot_row = self.state.dag.get(leader_round, {})
+        for s, name in enumerate(self._slots(leader_round)):
+            support = self._slot_support.get((leader_round, s), 0)
+            if support >= quorum:
+                got = slot_row.get(name)
+                if got is None:
+                    # Supporters cite a digest this DAG no longer holds
+                    # (equivocation overwrite race) — not anchorable.
+                    return None
+                return got[1], s
+            if child_stake - support < quorum:
+                # Undecided slot: it may still reach quorum, so no
+                # higher slot may anchor past it yet.
+                return None
+            # Dead slot (≤ f stake can ever cite it): scan on.
+        return None
+
+    def _cone_member(
+        self, leader_round: Round, frontier: List[Certificate]
+    ) -> Optional[Certificate]:
+        """Chain member for an even round during the descent: the first
+        slot whose leader has f+1 stake of supporters among the frontier
+        (= the causal cone of the nearest committed anchor above, at
+        round leader_round+1) — the indirect decision, identical on
+        every node because the cone is a pure function of the DAG."""
+        validity = self.committee.validity_threshold()
+        slot_row = self.state.dag.get(leader_round, {})
+        for name in self._slots(leader_round):
+            got = slot_row.get(name)
+            if got is None:
+                continue
+            digest = got[0]
+            support = sum(
+                self.committee.stake(x.origin)
+                for x in frontier
+                if digest in x.header.parents
+            )
+            if support >= validity:
+                return got[1]
+        return None
+
+    def order_leaders(self, leader: Certificate) -> List[Certificate]:
+        """Same single descending frontier pass as the base walk, but
+        the even-round membership test is the per-slot cone decision
+        (``_cone_member``) instead of the fixed single-leader lookup."""
+        state = self.state
+        index = state.digest_index
+        to_commit = [leader]
+        frontier = [leader]
+        fr = leader.round
+        while fr - 1 > state.last_committed_round:
+            wanted = set()
+            for x in frontier:
+                wanted.update(x.header.parents)
+            nxt = [
+                certificate
+                for digest in wanted
+                if (certificate := index.get(digest)) is not None
+                and certificate.round == fr - 1
+            ]
+            if not nxt:
+                # Empty causal cone: nothing deeper can be linked.
+                break
+            frontier = nxt
+            fr -= 1
+            if fr % 2 == 1 and fr - 1 > state.last_committed_round:
+                # The frontier sits at the child round of even round
+                # fr-1: decide that round's chain member inside it.
+                member = self._cone_member(fr - 1, frontier)
+                if member is not None:
+                    to_commit.append(member)
+                    frontier = [member]
+                    fr -= 1
+        return to_commit
+
+    def process_certificate(self, certificate: Certificate) -> List[Certificate]:
+        state = self.state
+        round = certificate.round
+        self.insert_certificate(certificate)
+
+        # Which leader round can this arrival have affected?  Odd-round
+        # certificates change slot support / child stake for round r-1
+        # (both the quorum and the dead-slot side of the scan); a slot
+        # leader's own arrival makes already-present support countable.
+        if round % 2 == 1:
+            leader_round = round - 1
+        elif certificate.origin in set(self._slots(round)):
+            leader_round = round
+        else:
+            return []
+        if leader_round < 2 or leader_round <= state.last_committed_round:
+            return []
+
+        anchor = self._direct_anchor(leader_round)
+        if anchor is None:
+            return []
+        leader, slot = anchor
+        self.last_anchor = (leader_round, slot)
+
+        log.debug(
+            "Slot %d leader %r has direct 2f+1 support", slot, leader
+        )
+        sequence: List[Certificate] = []
+        for past_leader in reversed(self.order_leaders(leader)):
+            for x in self.order_dag(past_leader):
+                state.note_committed(x)
+                sequence.append(x)
+        if sequence:
+            state.gc(self.gc_depth)
+            last = state.last_committed_round
+            for key in [k for k in self._slot_support if k[0] <= last]:
+                del self._slot_support[key]
+            for lr in [k for k in self._child_stake if k <= last]:
+                del self._child_stake[lr]
+            for lr in [k for k in self._slot_cache if k <= last]:
+                del self._slot_cache[lr]
+        return sequence
+
+
 def _sweep_checkpoint_tmps(checkpoint_path: str) -> None:
     """Unlink `<basename>.tmp.*` leftovers beside the checkpoint (boot
     only; see the call site in Consensus.__init__)."""
@@ -636,6 +979,10 @@ class Consensus:
             self.tusk = KernelTusk(committee, gc_depth, fixed_coin=fixed_coin)
         elif rule == "lowdepth":
             self.tusk = LowDepthTusk(committee, gc_depth, fixed_coin=fixed_coin)
+        elif rule == "multileader":
+            self.tusk = MultiLeaderTusk(
+                committee, gc_depth, fixed_coin=fixed_coin
+            )
         else:
             self.tusk = Tusk(committee, gc_depth, fixed_coin=fixed_coin)
         self.rx_primary = rx_primary
@@ -697,6 +1044,18 @@ class Consensus:
             )
             for n, a in committee.authorities.items()
         }
+        # Multileader anchor-slot distribution: which slot index anchored
+        # each direct commit.  Slot 0 dominating means the primary slot
+        # is healthy; weight on higher slots means the backup slots are
+        # earning their keep (a dead/undecided slot 0 was skipped).
+        self._m_anchor_slot = (
+            {
+                s: metrics.counter(f"consensus.anchor_slot.{s}")
+                for s in range(MULTILEADER_SLOTS)
+            }
+            if rule == "multileader"
+            else {}
+        )
         if self._c2c_on:
             _quorum = committee.quorum_threshold()
 
@@ -797,9 +1156,10 @@ class Consensus:
             self._audit.restore_marker(restored_blob)
             # The rule marker makes every segment self-describing: the
             # replay judge picks the matching frozen oracle per segment
-            # (GoldenTusk vs GoldenLowDepthTusk) instead of assuming a
-            # process-wide flag — a flag-flip sweep's two arms then
-            # judge themselves correctly with no harness plumbing.
+            # (GoldenTusk / GoldenLowDepthTusk / GoldenMultiLeaderTusk)
+            # instead of assuming a process-wide flag — a flag-flip
+            # sweep's arms then judge themselves correctly with no
+            # harness plumbing.
             self._audit.rule_marker(rule)
             self._audit.flush()
 
@@ -857,7 +1217,26 @@ class Consensus:
                     self._m_walk.observe(t_walk - t0)
                     # Flight-ring landmark: one event per commit burst
                     # (not per cert — bursts are the protocol unit and
-                    # the ring is bounded).
+                    # the ring is bounded).  Under the multileader rule
+                    # the burst also carries its anchor (leader round +
+                    # slot index) and that round's slot schedule, so the
+                    # Perfetto export can show which slot anchored and
+                    # which slots were passed over.
+                    extra = {}
+                    anchor = getattr(self.tusk, "last_anchor", None)
+                    if anchor is not None:
+                        anchor_round, anchor_slot = anchor
+                        extra = {
+                            "anchor_round": anchor_round,
+                            "anchor_slot": anchor_slot,
+                            "slots": ",".join(
+                                bytes(name).hex()[:8]
+                                for name in self.tusk._slots(anchor_round)
+                            ),
+                        }
+                        counter = self._m_anchor_slot.get(anchor_slot)
+                        if counter is not None:
+                            counter.inc()
                     metrics.flight_event(
                         "commit",
                         certs=len(sequence),
@@ -866,6 +1245,7 @@ class Consensus:
                         ),
                         round=state.last_committed_round,
                         walk_ms=round(1000 * (t_walk - t0), 2),
+                        **extra,
                     )
                 if sequence:
                     commit_ts = loop_now()

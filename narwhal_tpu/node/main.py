@@ -145,15 +145,18 @@ def main(argv=None) -> int:
     )
     run.add_argument(
         "--commit-rule",
-        choices=["classic", "lowdepth"],
+        choices=["classic", "lowdepth", "multileader"],
         default=None,
         help="Consensus commit rule: classic (Tusk, depth-3 commits on "
-        "f+1 support) or lowdepth (Mysticeti-style direct commit on "
-        "2f+1 support one round after the leader — judged against its "
-        "own golden oracle).  Default: the NARWHAL_COMMIT_RULE env "
-        "knob, else classic.  Committee-wide — every node must run the "
-        "same rule, and a checkpoint written under one rule refuses to "
-        "restore under the other.",
+        "f+1 support), lowdepth (Mysticeti-style direct commit on "
+        "2f+1 support one round after the leader), or multileader "
+        "(Mysticeti multi-slot: 3 round-salted leader slots per even "
+        "round, the commit anchors on the lowest supported slot) — each "
+        "non-classic rule judged against its own golden oracle.  "
+        "Default: the NARWHAL_COMMIT_RULE env knob, else classic.  "
+        "Committee-wide — every node must run the same rule, and a "
+        "checkpoint written under one rule refuses to restore under "
+        "another.",
     )
     run.add_argument(
         "--metrics-path",

@@ -111,6 +111,7 @@ class Core:
         tx_consensus: asyncio.Queue,
         tx_proposer: Optional[asyncio.Queue] = None,
         parents_cb: Optional[Callable[[List[Digest], Round], None]] = None,
+        late_parents_cb: Optional[Callable[[Digest, Round], None]] = None,
         fast_path: Optional[bool] = None,
         verify_window_ms: Optional[float] = None,
         verify_batch_max: Optional[int] = None,
@@ -138,6 +139,15 @@ class Core:
                 "(Proposer.deliver_parents) or a tx_proposer queue"
             )
         self.parents_cb = parents_cb
+        # Post-quorum parent forwarding (the proposer's header_linger
+        # window): a FRESH certificate of a round whose 2f+1 parent list
+        # already went out is offered to the Proposer as a late parent.
+        # Only wired when the linger is on — with no window open the
+        # callback would be pure per-certificate overhead.
+        self.late_parents_cb = late_parents_cb
+        # Rounds whose parent quorum has emitted (Dict so the _gc_sweep
+        # map loop collects it like the other per-round state).
+        self._parents_emitted: Dict[Round, None] = {}
         # Vote fast path (coalesced persist-before-vote); the env knob is
         # the A/B arm selector for bench_cadence.py.
         if fast_path is None:
@@ -551,8 +561,10 @@ class Core:
             # First FRESH certificate of this round's parent quorum
             # (origin-dedupe means a re-delivery never opens the window).
             self._parent_first_ts[certificate.round] = loop_now()
+        fresh = certificate.origin not in aggregator.used
         parents = aggregator.append(certificate, self.committee)
         if parents is not None:
+            self._parents_emitted[certificate.round] = None
             self._rtrace.mark(str(certificate.round), "parent_quorum")
             first_ts = self._parent_first_ts.get(certificate.round)
             if first_ts is not None:
@@ -569,6 +581,14 @@ class Core:
                 self.parents_cb(parents, certificate.round)
             elif self.tx_proposer is not None:
                 await self.tx_proposer.put((parents, certificate.round))
+        elif (
+            fresh
+            and self.late_parents_cb is not None
+            and certificate.round in self._parents_emitted
+        ):
+            # Quorum already emitted for this round: a fresh straggler
+            # can still be cited if the proposer's linger window is open.
+            self.late_parents_cb(certificate.digest(), certificate.round)
 
         await self.tx_consensus.put(certificate)
 
@@ -729,6 +749,7 @@ class Core:
                 self.processing,
                 self.certificates_aggregators,
                 self._parent_first_ts,
+                self._parents_emitted,
             ):
                 for k in [k for k in m if k < gc_round]:
                     del m[k]

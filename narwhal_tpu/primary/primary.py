@@ -196,6 +196,7 @@ class Primary:
             tx_core=tx_own_headers,
             benchmark=benchmark,
             min_header_delay_ms=parameters.min_header_delay,
+            header_linger_ms=parameters.header_linger,
         )
         core = core_cls(
             *extra,
@@ -212,6 +213,14 @@ class Primary:
             rx_proposer=tx_own_headers,
             tx_consensus=tx_consensus,
             parents_cb=proposer.deliver_parents,
+            # Late-parent forwarding only matters while a linger window
+            # can be open; leave it unwired otherwise so the post-quorum
+            # certificate path stays zero-cost.
+            late_parents_cb=(
+                proposer.deliver_late_parent
+                if parameters.header_linger > 0
+                else None
+            ),
         )
         garbage_collector = GarbageCollector(
             name, committee, consensus_round, rx_consensus

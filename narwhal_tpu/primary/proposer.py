@@ -19,6 +19,20 @@ Two cadence extensions beyond the reference (ISSUE r10):
   ``primary.round_advance_seconds`` observes) at quorum time; a wake event
   nudges the run loop to mint the next header.  The queue path (rx_core)
   is kept for harnesses that wire the Proposer standalone.
+
+And a third one (ISSUE r19, the multileader commit rule's proposer-side
+half):
+
+- **header_linger** — when > 0, a round advance arms a linger deadline
+  and the fast mint paths (payload-ready, full header) hold until it
+  passes; certificates of the just-advanced round that land AFTER the
+  2f+1 quorum are merged into the pending parent set via
+  :meth:`deliver_late_parent` (the Core forwards them while the round is
+  current).  Without it every header cites exactly the FIRST 2f+1
+  certificates of its round, so each commit-rule leader slot is cited
+  with probability ≈ 2/3 and slot support hovers at the quorum
+  borderline.  max_header_delay still caps the round; 0 disables the
+  window and keeps prior behavior bit-for-bit.
 """
 
 from __future__ import annotations
@@ -54,6 +68,7 @@ class Proposer:
         tx_core: asyncio.Queue,  # Header
         benchmark: bool = False,
         min_header_delay_ms: int = 0,
+        header_linger_ms: int = 0,
     ) -> None:
         self.name = name
         self.signature_service = signature_service
@@ -71,6 +86,17 @@ class Proposer:
         self.min_header_delay = min(
             min_header_delay_ms / 1000.0, self.max_header_delay
         )
+        # Linger is likewise bounded by the max deadline: a window the max
+        # timer always truncates would silently never run full length.
+        if header_linger_ms / 1000.0 > self.max_header_delay:
+            log.warning(
+                "header_linger (%d ms) exceeds max_header_delay "
+                "(%d ms); clamping to the max",
+                header_linger_ms, max_header_delay_ms,
+            )
+        self.header_linger = min(
+            header_linger_ms / 1000.0, self.max_header_delay
+        )
         self.rx_core = rx_core
         self.rx_workers = rx_workers
         self.tx_core = tx_core
@@ -83,7 +109,11 @@ class Proposer:
         # Set by deliver_parents (the Core's direct, queue-skipping path)
         # to nudge the run loop out of its queue wait.
         self._wake = asyncio.Event()
+        # Armed by _advance when header_linger > 0; the fast mint paths
+        # hold until it passes so late parents can still be cited.
+        self._linger_deadline = 0.0
         self._m_headers = metrics.counter("primary.headers_proposed")
+        self._m_late_parents = metrics.counter("primary.late_parents_cited")
         self._m_payload_digests = metrics.counter("primary.payload_digests")
         self._m_round = metrics.gauge("primary.round")
         # Round period: seconds between consecutive round advances.  The
@@ -109,6 +139,22 @@ class Proposer:
         self._advance(parents, round)
         self._wake.set()
 
+    def deliver_late_parent(self, digest: Digest, round: Round) -> None:
+        """Merge a post-quorum certificate of the CURRENT round's parent
+        round into the pending parent set (Core forwards these only while
+        a linger window can still be open).  A stale round, an
+        already-consumed parent set, or a duplicate digest are all
+        silently dropped — the certificate is already in the DAG either
+        way, this only widens the citation."""
+        if round + 1 != self.round or not self.last_parents:
+            return
+        if digest in self.last_parents:
+            return
+        self.last_parents.append(digest)
+        self._m_late_parents.inc()
+        if _TRACE:
+            log.info("TRACE late parent cited %r for round %d", digest, self.round)
+
     def _advance(self, parents: List[Digest], round: Round) -> bool:
         """Apply a parent quorum for ``round``; returns True if the round
         advanced.  Observes ``round_advance_seconds`` exactly once per
@@ -121,6 +167,7 @@ class Proposer:
         if self._last_advance is not None:
             self._m_round_advance.observe(now - self._last_advance)
         self._last_advance = now
+        self._linger_deadline = now + self.header_linger
         # Round-cadence trace: round `round`'s lifecycle ends here.
         self._rtrace.mark(str(round), "round_advance")
         metrics.flight_event("round_advance", round=self.round)
@@ -171,8 +218,11 @@ class Proposer:
                 ready = enough_digests or (
                     self.min_header_delay > 0 and bool(self.digests)
                 )
+                # The linger window holds the fast paths only; the max
+                # deadline is an unconditional ceiling.
+                linger_ok = now >= self._linger_deadline
                 if self.last_parents and (
-                    timer_expired or (min_expired and ready)
+                    timer_expired or (min_expired and linger_ok and ready)
                 ):
                     await self._make_header()
                     self.payload_size = 0
@@ -188,7 +238,11 @@ class Proposer:
                 if not self.last_parents:
                     timeout = None
                 elif ready:
-                    timeout = max(0.0, min_deadline - now)
+                    # Wake at whichever gate still holds the fast path —
+                    # min delay or linger — but never past the max
+                    # deadline, which mints unconditionally.
+                    gate = max(min_deadline, self._linger_deadline)
+                    timeout = max(0.0, min(deadline, gate) - now)
                 else:
                     timeout = max(0.0, deadline - now)
                 waits = {workers_get, wake_get}

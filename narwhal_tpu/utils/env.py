@@ -115,12 +115,14 @@ _VARS = [
     EnvVar(
         "NARWHAL_COMMIT_RULE", "str", "classic",
         "Commit rule (equivalent of `node run --commit-rule`): `classic` "
-        "(Tusk — leader commits at depth 3 on f+1 support) or `lowdepth` "
+        "(Tusk — leader commits at depth 3 on f+1 support), `lowdepth` "
         "(Mysticeti-style — leader commits the moment 2f+1 round-(L+1) "
-        "certificates cite it, judged against its own frozen oracle). "
-        "Committee-wide: mixed-rule committees diverge by design and "
-        "fail the safety replay; checkpoints refuse a cross-rule "
-        "restore.",
+        "certificates cite it), or `multileader` (Mysticeti multi-slot "
+        "— 3 round-salted leader slots per even round, the commit "
+        "anchors on the lowest 2f+1-supported slot); each non-classic "
+        "rule is judged against its own frozen oracle. Committee-wide: "
+        "mixed-rule committees diverge by design and fail the safety "
+        "replay; checkpoints refuse a cross-rule restore.",
     ),
     EnvVar(
         "NARWHAL_CHANNEL_CAPACITY", "int", 1_000,

@@ -30,6 +30,7 @@ CONFIG_ECHO = "\n".join(
         _t(0) + " INFO narwhal.node Header size set to 1000 B",
         _t(0) + " INFO narwhal.node Max header delay set to 100 ms",
         _t(0) + " INFO narwhal.node Min header delay set to 0 ms",
+        _t(0) + " INFO narwhal.node Header linger set to 0 ms",
         _t(0) + " INFO narwhal.node Garbage collection depth set to 50 rounds",
         _t(0) + " INFO narwhal.node Sync retry delay set to 5000 ms",
         _t(0) + " INFO narwhal.node Sync retry nodes set to 3 nodes",

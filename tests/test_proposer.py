@@ -19,7 +19,9 @@ def run():
     return _run
 
 
-def make_proposer(c, kp, header_size=1_000, delay_ms=50, min_delay_ms=0):
+def make_proposer(
+    c, kp, header_size=1_000, delay_ms=50, min_delay_ms=0, linger_ms=0
+):
     rx_core, rx_workers, tx_core = (
         asyncio.Queue(),
         asyncio.Queue(),
@@ -35,6 +37,7 @@ def make_proposer(c, kp, header_size=1_000, delay_ms=50, min_delay_ms=0):
         rx_workers,
         tx_core,
         min_header_delay_ms=min_delay_ms,
+        header_linger_ms=linger_ms,
     )
     return p, rx_core, rx_workers, tx_core
 
@@ -274,5 +277,88 @@ def test_min_header_delay_clamped_to_max(run):
             c, kp, header_size=1_000, delay_ms=100, min_delay_ms=500
         )
         assert p.min_header_delay == p.max_header_delay == 0.1
+
+    run(go())
+
+
+def test_header_linger_holds_mint_and_cites_late_parent(run):
+    """With header_linger on, a round advance arms a linger window: the
+    fast (payload-ready) mint path holds until it passes, and a
+    post-quorum certificate forwarded via deliver_late_parent inside
+    the window lands in the minted header's parent set.  Round 1 (no
+    advance yet) is unaffected."""
+
+    async def go():
+        c = committee()
+        kp = keys()[0]
+        p, _, rx_workers, tx_core = make_proposer(
+            c, kp, header_size=16, delay_ms=60_000, linger_ms=300
+        )
+        task = asyncio.ensure_future(p.run())
+        loop = asyncio.get_running_loop()
+        await rx_workers.put((digest32(b"a"), 0))
+        first = await asyncio.wait_for(tx_core.get(), 5)
+        assert first.round == 1  # no linger before the first advance
+        parents = [digest32(bytes([i]) * 3) for i in range(3)]
+        late = digest32(b"the straggler certificate")
+        t0 = loop.time()
+        p.deliver_parents(parents, 1)
+        await rx_workers.put((digest32(b"b"), 0))
+        # Payload + parents are ready, but the linger window holds...
+        await asyncio.sleep(0.1)
+        assert tx_core.empty()
+        # ...long enough for a post-quorum certificate to be merged.
+        p.deliver_late_parent(late, 1)
+        second = await asyncio.wait_for(tx_core.get(), 5)
+        assert loop.time() - t0 >= 0.25
+        assert second.round == 2
+        assert second.parents == set(parents) | {late}
+        task.cancel()
+
+    run(go())
+
+
+def test_deliver_late_parent_drops_stale_duplicate_and_consumed(run):
+    """The late-parent merge is citation-widening only: a stale round, a
+    duplicate digest, or an already-consumed parent set are silently
+    dropped."""
+
+    async def go():
+        c = committee()
+        kp = keys()[0]
+        p, _, _, _ = make_proposer(c, kp, linger_ms=100)
+        parents = [digest32(bytes([i]) * 3) for i in range(3)]
+        p.deliver_parents(parents, 1)
+        assert p.round == 2
+        # Stale round (certificate of round 2 while proposing round 2 —
+        # only parent-round certificates, round 1, merge).
+        p.deliver_late_parent(digest32(b"x"), 2)
+        assert len(p.last_parents) == 3
+        # Duplicate digest: no-op.
+        p.deliver_late_parent(parents[0], 1)
+        assert len(p.last_parents) == 3
+        # Fresh parent-round digest: merged.
+        extra = digest32(b"y")
+        p.deliver_late_parent(extra, 1)
+        assert p.last_parents[-1] == extra and len(p.last_parents) == 4
+        # Consumed parent set (post-mint): no resurrection.
+        p.last_parents = []
+        p.deliver_late_parent(digest32(b"z"), 1)
+        assert p.last_parents == []
+
+    run(go())
+
+
+def test_header_linger_clamped_to_max(run):
+    """A linger window the max deadline always truncates would silently
+    never run full length — it clamps to the max, loudly."""
+
+    async def go():
+        c = committee()
+        kp = keys()[0]
+        p, _, _, _ = make_proposer(
+            c, kp, header_size=1_000, delay_ms=100, linger_ms=500
+        )
+        assert p.header_linger == p.max_header_delay == 0.1
 
     run(go())
