@@ -13,7 +13,7 @@ PYTHON ?= python
 .PHONY: check native lint lint-invariants test test-ci metrics-smoke \
 	trace-smoke fault-smoke fault-fuzz-smoke trajectory race-explore \
 	sim-smoke wire-ab-smoke crypto-ab-smoke commit-rule-smoke \
-	knee-matrix knee-smoke sanitize bench clean
+	cert-scheme-smoke knee-matrix knee-smoke sanitize bench clean
 
 check: native lint test
 
@@ -198,6 +198,21 @@ commit-rule-smoke:
 		--points 20 --commit-rule all --mutation-seeds 8 \
 		--workdir .sim_commit_rule \
 		--artifact .ci-artifacts/sim-commit-rule-flip.json --quiet
+
+# Certificate-signature-scheme smoke (ISSUE 20): the frozen
+# differential/refusal suite (halfagg must never accept what
+# individual rejects; cross-scheme frames and checkpoints refuse
+# loudly), then the paired per-scheme N=20 sim wire captures gated on
+# the half-aggregation floor — exactly 1 verify op/cert, sig fraction
+# <= 0.5, cert bytes/frame < 0.75x individual.  The gate driver's
+# docstring explains why the thresholds are NOT the ISSUE's 0.25/0.6
+# (those price a pairing aggregate; no pairing library in-container).
+cert-scheme-smoke:
+	mkdir -p .ci-artifacts
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/test_cert_scheme.py -x -q
+	JAX_PLATFORMS=cpu $(PYTHON) benchmark/cert_scheme_gate.py \
+		--nodes 20 \
+		--artifact .ci-artifacts/cert_scheme_gate_n20.json
 
 # Saturation-knee matrix (ISSUE 17): sweep offered load across
 # committee sizes (socketed N=4, sim N=10/20), locate each config's

@@ -1,0 +1,242 @@
+"""Paired interleaved cert-sig-scheme A/B: individual vs halfagg at N=4.
+
+The socketed leg of the ISSUE 20 measurement ladder (the sim pricing
+at N=10/20/50 lives in benchmark/cert_scheme_gate.py): real processes,
+real ed25519 (pure-Python on this host), real sockets, arms
+interleaved (individual, halfagg, individual, ...) so slow host drift
+hits both equally — the r09/r10 A/B convention.
+
+Ledger-read gates:
+
+* zero run errors and ``protocol_check`` within 5% on BOTH arms — the
+  claims arithmetic is scheme-aware (2 claims/cert under halfagg vs
+  quorum+1), so a drifting ratio means the assembly or the summary
+  lies about the scheme;
+* the halfagg arm's ``cert_sig_bytes_per_cert`` must match the scheme
+  formula exactly (wire anatomy is deterministic) and shrink vs the
+  individual arm;
+* halfagg median committed TPS no worse than ``--tps-tolerance``
+  below individual (N=4/q=3 is the WORST case for halfagg — one
+  multiexp vs only 3 serial verifies — so this is a no-regression
+  floor, not a win claim; the win is the wire bytes and the N>=20
+  verify collapse priced by the sim captures).
+
+Artifact shape follows wire_ab.py: ``runs`` carries the halfagg arm,
+``individual_runs`` the baseline.
+
+    python benchmark/cert_scheme_ab.py --pairs 2 --duration 8 \
+        --artifact artifacts/cert_scheme_ab_r24.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmark.local_bench import run_bench  # noqa: E402
+from narwhal_tpu.crypto.aggregate import cert_sig_wire_bytes  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _one_run(arm: str, idx: int, args) -> dict:
+    result = run_bench(
+        nodes=args.nodes,
+        workers=1,
+        rate=args.rate,
+        tx_size=args.tx_size,
+        duration=args.duration,
+        base_port=args.base_port,
+        workdir=os.path.join(REPO, ".bench_cert_scheme_ab"),
+        quiet=True,
+        progress_wait=args.progress_wait,
+        cert_sig_scheme=arm,
+    )
+    wire = result.wire or {}
+    return {
+        "arm": arm,
+        "run": idx,
+        "errors": result.errors,
+        "consensus_tps": result.consensus_tps,
+        "consensus_latency_ms": result.consensus_latency_ms,
+        "end_to_end_tps": result.end_to_end_tps,
+        "end_to_end_latency_ms": result.end_to_end_latency_ms,
+        "wire": wire,
+        "round_stages_ms": result.round_stages_ms,
+        "crypto": {
+            "protocol_check": (result.crypto or {}).get("protocol_check"),
+            "verify": (result.crypto or {}).get("verify"),
+        },
+    }
+
+
+def _median(runs, key, default=0.0):
+    vals = [r.get(key) or 0.0 for r in runs]
+    return statistics.median(vals) if vals else default
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--pairs", type=int, default=2)
+    ap.add_argument("--nodes", type=int, default=4)
+    ap.add_argument("--rate", type=int, default=2_000)
+    ap.add_argument("--tx-size", type=int, default=512)
+    ap.add_argument("--duration", type=int, default=8)
+    ap.add_argument("--base-port", type=int, default=7900)
+    ap.add_argument("--progress-wait", type=float, default=30.0)
+    ap.add_argument(
+        "--tps-tolerance", type=float, default=0.25,
+        help="halfagg median committed TPS may be at most this fraction "
+        "below the individual arm's (shared-core noise floor)",
+    )
+    ap.add_argument(
+        "--artifact", default="artifacts/cert_scheme_ab_r24.json"
+    )
+    args = ap.parse_args(argv)
+
+    runs_hag, runs_ind = [], []
+    for i in range(args.pairs):
+        for arm, into in (("individual", runs_ind), ("halfagg", runs_hag)):
+            print(
+                f"== cert-scheme A/B pair {i + 1}/{args.pairs}: "
+                f"{arm} arm =="
+            )
+            r = _one_run(arm, i, args)
+            into.append(r)
+            print(
+                f"   committed TPS {r['consensus_tps']:,.0f}, "
+                f"scheme {r['wire'].get('cert_sig_scheme')}, cert sig "
+                f"B/cert {r['wire'].get('cert_sig_bytes_per_cert')}"
+            )
+
+    failures = []
+    quorum = 2 * args.nodes // 3 + 1
+    for r in runs_hag + runs_ind:
+        if r["errors"]:
+            failures.append(f"{r['arm']} run {r['run']}: {r['errors'][:3]}")
+        scheme = r["wire"].get("cert_sig_scheme")
+        if scheme != r["arm"]:
+            failures.append(
+                f"{r['arm']} run {r['run']}: ledger says scheme {scheme}"
+            )
+        wv = r["wire"].get("format_version") or 1
+        want = cert_sig_wire_bytes(r["arm"], quorum, wv)
+        got = r["wire"].get("cert_sig_bytes_per_cert")
+        if got != want:
+            failures.append(
+                f"{r['arm']} run {r['run']}: cert_sig_bytes_per_cert "
+                f"{got} != formula {want} (q={quorum}, wire v{wv})"
+            )
+        check = (r["crypto"] or {}).get("protocol_check") or {}
+        for kind in ("votes", "certificates"):
+            ratio = (check.get(kind) or {}).get("ratio")
+            if ratio is None or abs(ratio - 1.0) > 0.05:
+                failures.append(
+                    f"{r['arm']} run {r['run']}: protocol_check.{kind} "
+                    f"ratio {ratio}"
+                )
+
+    tps_ind = _median(runs_ind, "consensus_tps")
+    tps_hag = _median(runs_hag, "consensus_tps")
+    if tps_ind and tps_hag < tps_ind * (1 - args.tps_tolerance):
+        failures.append(
+            f"halfagg median committed TPS {tps_hag:,.0f} more than "
+            f"{args.tps_tolerance:.0%} below individual {tps_ind:,.0f}"
+        )
+
+    sig_ind = _median([r["wire"] for r in runs_ind], "cert_sig_bytes_per_cert")
+    sig_hag = _median([r["wire"] for r in runs_hag], "cert_sig_bytes_per_cert")
+    if sig_ind and sig_hag >= sig_ind:
+        failures.append(
+            f"halfagg cert sig bytes {sig_hag} not below individual "
+            f"{sig_ind}"
+        )
+
+    def _agg_site(runs):
+        mids = [
+            ((r["crypto"] or {}).get("verify") or {}).get("certificate_agg")
+            for r in runs
+        ]
+        return [m for m in mids if m]
+
+    agg_sites = _agg_site(runs_hag)
+    ops_per_cert = None
+    if agg_sites:
+        tot_ops = sum(s.get("ops", 0) for s in agg_sites)
+        tot_calls = sum(s.get("calls", 0) for s in agg_sites)
+        ops_per_cert = round(tot_ops / tot_calls, 4) if tot_calls else None
+    if ops_per_cert != 1.0:
+        failures.append(
+            f"halfagg verify ops per certificate_agg call = "
+            f"{ops_per_cert}, expected exactly 1"
+        )
+    if _agg_site(runs_ind):
+        failures.append(
+            "individual arm recorded certificate_agg ops (scheme leak)"
+        )
+
+    summary = {
+        "consensus_tps": {"individual": tps_ind, "halfagg": tps_hag},
+        "cert_sig_bytes_per_cert": {
+            "individual": sig_ind, "halfagg": sig_hag,
+        },
+        "cert_sig_bytes_fraction": {
+            "individual": _median(
+                [r["wire"] for r in runs_ind], "cert_sig_bytes_fraction"
+            ),
+            "halfagg": _median(
+                [r["wire"] for r in runs_hag], "cert_sig_bytes_fraction"
+            ),
+        },
+        "halfagg_verify_ops_per_cert": ops_per_cert,
+        "consensus_latency_ms": {
+            "individual": _median(runs_ind, "consensus_latency_ms"),
+            "halfagg": _median(runs_hag, "consensus_latency_ms"),
+        },
+        "gates_failed": failures,
+    }
+
+    artifact = {
+        "what": (
+            "Paired interleaved cert-sig-scheme A/B (ISSUE 20): "
+            "individual vs halfagg on a "
+            f"{args.nodes}-node local_bench, rate {args.rate}, "
+            f"{args.tx_size} B tx, {args.duration} s windows, real "
+            "ed25519 (pure-Python signer on this host).  N=4/q=3 is "
+            "halfagg's WORST case (one multiexp vs 3 serial verifies), "
+            "so the TPS gate is a no-regression floor; the wire and "
+            "verify-collapse wins are priced at N=10/20/50 by "
+            "artifacts/cert_scheme_price_n*_r24.json.  `runs` is the "
+            "halfagg arm; the individual arm is `individual_runs` "
+            "(key ignored by the trajectory loader on purpose — the "
+            "halfagg arm is not the default scheme and must not set "
+            "the TPS series)."
+        ),
+        "runs_excluded_from_trajectory": runs_hag,
+        "individual_runs": runs_ind,
+        "summary": summary,
+    }
+    os.makedirs(os.path.dirname(args.artifact) or ".", exist_ok=True)
+    with open(args.artifact, "w") as f:
+        json.dump(artifact, f, indent=1)
+
+    print("== cert-scheme A/B summary ==")
+    print(json.dumps(summary, indent=1))
+    if failures:
+        print(f"cert-scheme A/B FAILED: {failures}", file=sys.stderr)
+        return 1
+    print(
+        f"cert-scheme A/B ok: cert sig bytes {sig_ind:.0f} -> "
+        f"{sig_hag:.0f} per cert at committed TPS {tps_ind:,.0f} -> "
+        f"{tps_hag:,.0f}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -208,6 +208,7 @@ def run_bench(
     wire_v2: bool = None,
     verify_window_ms: float = None,
     commit_rule: str = None,
+    cert_sig_scheme: str = None,
 ):
     """Run one committee + clients on localhost; return the ParseResult.
 
@@ -289,6 +290,12 @@ def run_bench(
         # the env knob, and each primary's boot log records the rule.
         cpu_env["NARWHAL_COMMIT_RULE"] = commit_rule
         tpu_env["NARWHAL_COMMIT_RULE"] = commit_rule
+    if cert_sig_scheme is not None:
+        # Cert-sig-scheme A/B arm pin: committee-wide like the commit
+        # rule — a mixed-scheme committee refuses each other's
+        # certificate frames by design (SchemeMismatch).
+        cpu_env["NARWHAL_CERT_SIG_SCHEME"] = cert_sig_scheme
+        tpu_env["NARWHAL_CERT_SIG_SCHEME"] = cert_sig_scheme
     procs = []
     primary_logs, worker_logs, client_logs = [], [], []
     metrics_paths = []

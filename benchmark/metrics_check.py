@@ -30,6 +30,10 @@ from typing import Dict, List, Optional, Tuple
 # Causal stage order: the registry's definition IS the source of truth
 # (a hand-copied tuple here would silently drop any future stage from
 # the breakdown).
+from narwhal_tpu.crypto.aggregate import (
+    SCHEMES as CERT_SIG_SCHEMES,
+    cert_sig_wire_bytes,
+)
 from narwhal_tpu.metrics import ROUND_STAGES, STAGES as STAGE_ORDER
 from narwhal_tpu.network import clocksync
 
@@ -489,18 +493,18 @@ def round_attribution(snapshots: List[dict]) -> dict:
 
 # -- wire-goodput & crypto-cost ledger joins ----------------------------------
 
-# An ed25519-signed vote inside a certificate costs 32 B (voter public
-# key) + 64 B (signature) on the wire; the embedded header adds one more
-# 64 B signature.  Certificates carry exactly quorum_threshold votes
+# An ed25519-signed vote inside a certificate costs a key ref (32 B
+# raw key, ~1 B committee index under wire v2) + 64 B signature on the
+# wire; the embedded header adds one more 64 B signature; under the
+# halfagg scheme the per-vote signatures collapse to one 32·(q+1) B
+# aggregate blob.  Certificates carry exactly quorum_threshold votes
 # (the VotesAggregator assembles at quorum and stops), so the signature
-# bytes of a cert frame are a pure function of the committee.  Under
-# wire v2 the voter key rides as a ~1 B committee index, so the
-# per-vote signature material is 64 B sig + 1 B ref; the fraction is
-# computed against the RAW (pre-compression) cert frame size in both
-# formats, so it keeps measuring frame anatomy, not deflate luck.
-_VOTE_WIRE_BYTES = 96
-_VOTE_WIRE_BYTES_V2 = 65
-_HEADER_SIG_BYTES = 64
+# bytes of a cert frame are a pure function of committee size, wire
+# format, and cert-sig scheme — all three are read from node gauges and
+# fed to crypto.aggregate.cert_sig_wire_bytes rather than hardcoded
+# here.  The fraction is computed against the RAW (pre-compression)
+# cert frame size in both formats, so it keeps measuring frame anatomy,
+# not deflate luck.
 
 
 def _agg_counters(snapshots: List[dict]) -> Dict[str, float]:
@@ -684,9 +688,10 @@ def wire_crypto_summary(
       retries.  Frame payload bytes only (length prefixes and tiny ACK
       replies excluded on both directions alike).
     - ``cert_sig_bytes_fraction`` — fraction of a certificate frame that
-      is signature material (quorum × 96 B votes + 64 B header sig ÷
-      mean cert frame size): the byte-level cost aggregate signatures
-      (ROADMAP item 5) would collapse to ~96 B.
+      is signature material (crypto.aggregate.cert_sig_wire_bytes under
+      the scheme/format the committee ran ÷ mean cert frame size): the
+      byte-level number the ``halfagg`` scheme roughly halves and a
+      pairing-based aggregate would collapse to ~96 B.
     - ``empty_cert_overhead_per_committed_byte`` — control-plane bytes
       (header/vote/certificate frames) attributed to EMPTY rounds, per
       committed payload byte: the "empty certs per committed byte"
@@ -695,9 +700,11 @@ def wire_crypto_summary(
 
     The crypto section's ``protocol_check`` cross-validates the ledger
     against protocol arithmetic: one verified claim per peer vote, and
-    quorum+1 claims (2f+1 votes + 1 header sig) per certificate arriving
-    over the wire — within tolerance on a clean run; the verify cache
-    (re-deliveries) and in-flight teardown account for the residue.
+    per certificate arriving over the wire either quorum+1 claims
+    (2f+1 votes + 1 header sig, ``individual``) or exactly 2 (one
+    aggregate + 1 header sig, ``halfagg``) — within tolerance on a
+    clean run; the verify cache (re-deliveries) and in-flight teardown
+    account for the residue.
     """
     counters = _agg_counters(snapshots)
     hists = _agg_histograms(snapshots)
@@ -721,11 +728,23 @@ def wire_crypto_summary(
     # stamped by every node): drives the format-aware signature
     # arithmetic below.  Max across nodes — the flag is committee-wide.
     wire_version = 1
+    # Which certificate-signature scheme it ran (crypto.cert_sig_scheme
+    # gauge, an index into crypto.aggregate.SCHEMES).  Same max-across-
+    # nodes read: a mixed committee is refused at the wire, so on any
+    # run that produced certificates the gauge agrees everywhere.
+    scheme_index = 0
     for snap in snapshots:
         if snap.get("enabled", True):
-            v = (snap.get("gauges") or {}).get("wire.format_version")
+            gauges = snap.get("gauges") or {}
+            v = gauges.get("wire.format_version")
             if v:
                 wire_version = max(wire_version, int(v))
+            s = gauges.get("crypto.cert_sig_scheme")
+            if s:
+                scheme_index = max(scheme_index, int(s))
+    cert_scheme = CERT_SIG_SCHEMES[
+        min(scheme_index, len(CERT_SIG_SCHEMES) - 1)
+    ]
 
     types = sorted(
         set(out_bytes) | set(in_bytes) | set(re_bytes)
@@ -745,6 +764,7 @@ def wire_crypto_summary(
 
     wire: dict = {
         "format_version": wire_version,
+        "cert_sig_scheme": cert_scheme,
         "out": {
             t: {
                 "frames": int(out_frames.get(t, 0)),
@@ -815,10 +835,9 @@ def wire_crypto_summary(
     )
     cert_frames = out_frames.get("certificate", 0)
     if quorum_weight and cert_frames:
-        vote_wire = (
-            _VOTE_WIRE_BYTES_V2 if wire_version >= 2 else _VOTE_WIRE_BYTES
+        sig_bytes = cert_sig_wire_bytes(
+            cert_scheme, quorum_weight, wire_version
         )
-        sig_bytes = vote_wire * quorum_weight + _HEADER_SIG_BYTES
         wire["cert_sig_bytes_per_cert"] = sig_bytes
         wire["cert_sig_bytes_fraction"] = round(
             sig_bytes / (cert_bytes / cert_frames), 4
@@ -901,13 +920,18 @@ def wire_crypto_summary(
     wire_certs = certs_in - certs_own
     if quorum_weight and wire_certs > 0:
         claims_per_cert = claims.get("certificate", 0) / wire_certs
+        # individual: 2f+1 vote signatures + the embedded header's
+        # signature.  halfagg: ONE aggregate claim + the header's —
+        # the "2f+1 → 1 verify per cert" ledger witness.
+        expected_claims = (
+            2 if cert_scheme == "halfagg" else quorum_weight + 1
+        )
         check["certificates"] = {
             "claims": claims.get("certificate", 0),
             "wire_certs": int(wire_certs),
             "claims_per_cert": round(claims_per_cert, 3),
-            # 2f+1 vote signatures + the embedded header's signature.
-            "expected_claims_per_cert": quorum_weight + 1,
-            "ratio": round(claims_per_cert / (quorum_weight + 1), 4),
+            "expected_claims_per_cert": expected_claims,
+            "ratio": round(claims_per_cert / expected_claims, 4),
         }
     if check:
         crypto["protocol_check"] = check

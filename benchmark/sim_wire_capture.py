@@ -39,9 +39,11 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def capture(nodes: int, duration: int, rate: int, seed: int,
-            workdir: str) -> dict:
+            workdir: str, cert_sig_scheme: str | None = None,
+            commit_rule: str | None = None) -> dict:
     obj = {
-        "name": f"wire_capture_n{nodes}",
+        "name": f"wire_capture_n{nodes}"
+        + (f"_{cert_sig_scheme}" if cert_sig_scheme else ""),
         "nodes": nodes,
         "workers": 1,
         "rate": rate,
@@ -49,14 +51,37 @@ def capture(nodes: int, duration: int, rate: int, seed: int,
         "duration": duration,
         "seed": seed,
     }
+    if cert_sig_scheme is not None:
+        # The sim committee scopes NARWHAL_CERT_SIG_SCHEME from the
+        # scenario env to the run (saved/restored like the sim-MAC
+        # bracket), so paired arms can share one process.
+        obj["env"] = {"NARWHAL_CERT_SIG_SCHEME": cert_sig_scheme}
     scenario = parse_scenario(obj, env={})
-    art = run_sim_scenario(scenario, seed + 1, workdir)
-    # The sim committee shares ONE registry; its post-run snapshot is
-    # the committee-aggregated ledger (the reset happens at the START
-    # of the next run, so the counters are intact here).
-    snap = metrics.registry().snapshot()
-    quorum = 2 * nodes // 3 + 1  # Committee.quorum_threshold, unit stake
-    wc = wire_crypto_summary([snap], quorum_weight=quorum)
+    from narwhal_tpu.crypto.aggregate import (
+        resolve_scheme,
+        scheme_override,
+        set_scheme,
+    )
+
+    # The registry snapshot (and its crypto.cert_sig_scheme gauge_fn)
+    # is taken AFTER the sim's run bracket restores the process scheme,
+    # so hold the arm's scheme across run + snapshot + summary or the
+    # frame anatomy prices the wrong formula.
+    prev_scheme = scheme_override()
+    if cert_sig_scheme is not None:
+        set_scheme(resolve_scheme(cert_sig_scheme))
+    try:
+        art = run_sim_scenario(
+            scenario, seed + 1, workdir, commit_rule=commit_rule
+        )
+        # The sim committee shares ONE registry; its post-run snapshot
+        # is the committee-aggregated ledger (the reset happens at the
+        # START of the next run, so the counters are intact here).
+        snap = metrics.registry().snapshot()
+        quorum = 2 * nodes // 3 + 1  # Committee.quorum_threshold
+        wc = wire_crypto_summary([snap], quorum_weight=quorum)
+    finally:
+        set_scheme(prev_scheme)
     return {
         "what": (
             f"Clean simulated N={nodes} committee wire/crypto ledger "
@@ -68,12 +93,19 @@ def capture(nodes: int, duration: int, rate: int, seed: int,
         ),
         "nodes": nodes,
         "quorum": quorum,
+        "commit_rule": commit_rule or "classic",
         "verdicts_ok": art["ok"],
         "schedule": art["schedule"],
         "wall": art["wall"],
+        # Per-leader first→2f+1 direct-support arrival spread on the
+        # virtual clock — the number that decides whether smaller
+        # certificate frames (halfagg) loosen the ISSUE 19 N>=10
+        # support-spread wall.
+        "support_arrival": art.get("support_arrival"),
         "wire": wc["wire"],
         "crypto": wc["crypto"],
         "headline": {
+            "cert_sig_scheme": wc["wire"].get("cert_sig_scheme"),
             "cert_sig_bytes_fraction": wc["wire"].get(
                 "cert_sig_bytes_fraction"
             ),
@@ -104,11 +136,27 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--workdir", default=os.path.join(REPO, ".sim_wire_capture")
     )
+    ap.add_argument(
+        "--cert-sig-scheme",
+        choices=["individual", "halfagg"],
+        default=None,
+        help="pin the certificate-signature scheme for this capture "
+        "(scoped to the run via the scenario env; default: whatever "
+        "the process/NARWHAL_CERT_SIG_SCHEME setting is)",
+    )
+    ap.add_argument(
+        "--commit-rule",
+        choices=["classic", "lowdepth", "multileader"],
+        default=None,
+        help="consensus commit rule for the committee (default: classic)",
+    )
     ap.add_argument("--artifact", default="artifacts/wire_n20_r19.json")
     args = ap.parse_args(argv)
 
     art = capture(
-        args.nodes, args.duration, args.rate, args.seed, args.workdir
+        args.nodes, args.duration, args.rate, args.seed, args.workdir,
+        cert_sig_scheme=args.cert_sig_scheme,
+        commit_rule=args.commit_rule,
     )
     os.makedirs(os.path.dirname(args.artifact) or ".", exist_ok=True)
     with open(args.artifact, "w") as f:

@@ -78,6 +78,14 @@ LOWER_BETTER = {
     # committee getting slower at the exact quorum the commit rule
     # waits on, upstream of any cert_to_commit movement.
     "support_arrival_ms",
+    # Halfagg signature fraction of the certificate frame at the pinned
+    # N=20 sim capture (PR 20) — the one artifacts/-sourced metric that
+    # IS gated: the capture is deterministic per seed at one committee
+    # size, so unlike the bench-JSON fraction it cannot move for
+    # non-code reasons.  Lower is better (the aggregate shrinking, or a
+    # pairing backend landing, pushes it down; an encoder regression
+    # pushes it up).
+    "attr.cert_sig_bytes_fraction",
 }
 # Pipeline stage legs (stage.<leg>) are lower-better but host-noise
 # swings them ±40% (r09/r10 artifacts), so they are tracked, not gated.
@@ -222,6 +230,32 @@ def load_bench_file(path: str) -> Tuple[Optional[Dict[str, float]], str]:
             return metrics, "ok (knee matrix)"
         return None, "knee matrix without located knees"
 
+    # Cert-scheme paired capture (benchmark/cert_scheme_gate): per-
+    # scheme sim wire captures at ONE pinned committee size.  The N=20
+    # halfagg signature fraction graduates to the gated lower-is-better
+    # `attr.cert_sig_bytes_fraction` series — unlike the bench-JSON
+    # fraction (which moves with committee size and stays ungated),
+    # this capture is deterministic per seed at a fixed size, so it is
+    # cross-revision comparable.  Other sizes are tracked under
+    # cert_scheme.n<N>.* informationally.
+    if d.get("generated_by") == "benchmark/cert_scheme_gate":
+        metrics = {}
+        n = d.get("nodes")
+        hl = d.get("headline") or {}
+        hag = hl.get("halfagg") or {}
+        if isinstance(n, int):
+            frac = _num(hag.get("cert_sig_bytes_fraction"))
+            ratio = _num(hl.get("cert_bytes_per_frame_ratio"))
+            if frac is not None:
+                metrics[f"cert_scheme.n{n}.halfagg_sig_fraction"] = frac
+                if n == 20:
+                    metrics["cert_sig_bytes_fraction"] = frac
+            if ratio is not None:
+                metrics[f"cert_scheme.n{n}.frame_ratio"] = ratio
+        if metrics:
+            return metrics, "ok (cert-scheme capture)"
+        return None, "cert-scheme capture without headline numbers"
+
     # Driver wrapper: {n, cmd, rc, tail, parsed}.
     if "parsed" in d and "cmd" in d:
         rc = d.get("rc")
@@ -286,7 +320,10 @@ def collect(root: str, quiet: bool = False) -> Tuple[dict, List[dict]]:
         # r07/r09 stage-breakdown attributions at rate 3000) — their
         # numbers are cross-revision comparable with each other but not
         # with the saturation probe, so they land in an `attr.`
-        # namespace the gate config never names.
+        # namespace the gate config mostly never names (the one
+        # exception: attr.cert_sig_bytes_fraction, whose pinned-size
+        # deterministic capture is the comparability the namespace
+        # split exists to protect — see LOWER_BETTER).
         if os.path.dirname(rel):
             metrics = {f"attr.{n}": v for n, v in metrics.items()}
         entry = revisions.setdefault(rev, {"metrics": {}, "sources": []})

@@ -34,6 +34,7 @@ from ..config import Committee
 from ..crypto import Digest, PublicKey
 from ..messages import Round
 from ..primary.messages import Certificate, genesis
+from .tusk import _check_scheme_trailer, _scheme_trailer
 
 log = logging.getLogger("narwhal.consensus")
 
@@ -63,6 +64,7 @@ class GoldenState:
             if len(bytes(name)) != 32:
                 raise ValueError("checkpoint: authority key must be 32 bytes")
             out += bytes(name) + struct.pack("<Q", round)
+        out += _scheme_trailer()
         return bytes(out)
 
     def restore(self, blob: bytes) -> None:
@@ -70,8 +72,7 @@ class GoldenState:
             raise ValueError("checkpoint: bad magic")
         (last_round,) = struct.unpack_from("<Q", blob, 6)
         (n,) = struct.unpack_from("<I", blob, 14)
-        if len(blob) != 18 + 40 * n:
-            raise ValueError("checkpoint: truncated or oversized blob")
+        _check_scheme_trailer(blob, 18 + 40 * n)
         entries = []
         pos = 18
         for _ in range(n):

@@ -58,52 +58,76 @@ log = logging.getLogger("narwhal.consensus")
 
 class _CertDecoder:
     """Decode audit certificate payloads, sniffing the RECORDING's wire
-    arm: the nodes that wrote the segments may have run the other
-    ``NARWHAL_WIRE_V2`` arm than this (harness) process — e.g. auditing
-    a legacy-arm bench workdir under the default-on flag.  The first
-    payload that fails to decode under the process arm is retried under
-    the flipped arm; whichever works is pinned for the rest of the
-    replay (a recording is single-arm by construction — the flag is
-    committee-wide and process-constant)."""
+    arm and certificate-signature scheme: the nodes that wrote the
+    segments may have run the other ``NARWHAL_WIRE_V2`` arm or the
+    other ``NARWHAL_CERT_SIG_SCHEME`` than this (harness) process —
+    e.g. auditing a halfagg-arm sim workdir after the run bracket
+    restored the process scheme.  The live decode path refuses
+    cross-scheme frames LOUDLY (SchemeMismatch — the mixed-committee
+    guard), but replay judges a FINISHED recording, so that refusal is
+    re-read here as arm information: the first payload is tried under
+    the process (arm, scheme) and then the flipped combinations;
+    whichever decodes is pinned for the rest of the replay (a
+    recording is single-arm/single-scheme by construction — both flags
+    are committee-wide and process-constant)."""
 
-    __slots__ = ("arm",)
+    __slots__ = ("arm", "scheme")
 
     def __init__(self) -> None:
-        self.arm: Optional[bool] = None  # None = process flag untested
+        self.arm: Optional[bool] = None  # None = process flags untested
+        self.scheme: Optional[str] = None
 
-    def __call__(self, payload: bytes) -> Certificate:
+    @staticmethod
+    def _decode(payload: bytes, arm: bool, scheme: str) -> Certificate:
+        from ..crypto import aggregate
         from ..network import wirev2
 
-        if self.arm is None:
-            try:
-                cert = Certificate.deserialize(payload)
-                self.arm = wirev2.enabled()
-                return cert
-            except Exception:
-                flipped = not wirev2.enabled()
-                prev = wirev2.enabled_override()
-                wirev2.set_enabled(flipped)
-                try:
-                    cert = Certificate.deserialize(payload)
-                finally:
-                    wirev2.set_enabled(prev)
-                log.warning(
-                    "audit replay: certificates decode under "
-                    "NARWHAL_WIRE_V2=%d, not this process's arm — the "
-                    "recording ran the other wire format; pinning it "
-                    "for this replay",
-                    1 if flipped else 0,
-                )
-                self.arm = flipped
-                return cert
-        if self.arm == wirev2.enabled():
-            return Certificate.deserialize(payload)
-        prev = wirev2.enabled_override()
-        wirev2.set_enabled(self.arm)
+        prev_arm = wirev2.enabled_override()
+        prev_scheme = aggregate.scheme_override()
+        wirev2.set_enabled(arm)
+        aggregate.set_scheme(scheme)
         try:
             return Certificate.deserialize(payload)
         finally:
-            wirev2.set_enabled(prev)
+            wirev2.set_enabled(prev_arm)
+            aggregate.set_scheme(prev_scheme)
+
+    def __call__(self, payload: bytes) -> Certificate:
+        from ..crypto import aggregate
+        from ..network import wirev2
+
+        if self.arm is None:
+            proc_arm = wirev2.enabled()
+            proc_scheme = aggregate.scheme()
+            other_scheme = (
+                "halfagg" if proc_scheme == "individual" else "individual"
+            )
+            last_exc: Optional[Exception] = None
+            for arm, scheme in (
+                (proc_arm, proc_scheme),
+                (proc_arm, other_scheme),
+                (not proc_arm, proc_scheme),
+                (not proc_arm, other_scheme),
+            ):
+                try:
+                    cert = self._decode(payload, arm, scheme)
+                except Exception as exc:
+                    last_exc = exc
+                    continue
+                if (arm, scheme) != (proc_arm, proc_scheme):
+                    log.warning(
+                        "audit replay: certificates decode under "
+                        "NARWHAL_WIRE_V2=%d / cert-sig-scheme %s, not "
+                        "this process's arm — the recording ran the "
+                        "other configuration; pinning it for this "
+                        "replay",
+                        1 if arm else 0,
+                        scheme,
+                    )
+                self.arm, self.scheme = arm, scheme
+                return cert
+            raise last_exc  # type: ignore[misc]
+        return self._decode(payload, self.arm, self.scheme)
 
 _LEN = struct.Struct("<I")
 

@@ -121,6 +121,51 @@ def leader_slots(
     return [head] + rest[: k - 1]
 
 
+# Checkpoint cert-sig scheme trailer: 4-byte tag + scheme index,
+# appended after the frontier entries.  A frontier is only meaningful
+# next to a store the running scheme can replay (the boot-time
+# _replay_persisted_certificates feeds the DAG between frontier and
+# head back into consensus, and cross-scheme certificates refuse to
+# decode) — so a checkpoint written under one scheme refuses to restore
+# under the other, in both directions, naming both schemes.  A trailer-
+# less checkpoint predates the scheme seam and was necessarily written
+# under "individual".
+_SCHEME_TRAILER_TAG = b"SCHM"
+_SCHEME_TRAILER_LEN = len(_SCHEME_TRAILER_TAG) + 1
+
+
+def _scheme_trailer() -> bytes:
+    from ..crypto.aggregate import SCHEMES, scheme
+
+    return _SCHEME_TRAILER_TAG + bytes([SCHEMES.index(scheme())])
+
+
+def _check_scheme_trailer(blob: bytes, body_len: int) -> None:
+    """Validate a checkpoint's scheme trailer against the running
+    scheme.  ``body_len`` is the magic+frontier length; raises
+    SchemeMismatch (both names) or ValueError on garbage."""
+    from ..crypto.aggregate import SCHEMES, SchemeMismatch, scheme
+
+    if len(blob) == body_len:
+        written = "individual"  # pre-scheme checkpoint
+    elif (
+        len(blob) == body_len + _SCHEME_TRAILER_LEN
+        and blob[body_len : body_len + 4] == _SCHEME_TRAILER_TAG
+        and blob[-1] < len(SCHEMES)
+    ):
+        written = SCHEMES[blob[-1]]
+    else:
+        raise ValueError("checkpoint: truncated or oversized blob")
+    if written != scheme():
+        raise SchemeMismatch(
+            f"checkpoint was written under cert-sig scheme {written!r} "
+            f"but this node runs {scheme()!r}; refusing to restore — the "
+            "persisted store next to it cannot replay across schemes.  "
+            "Wipe the checkpoint+store (and accept re-delivery) or run "
+            "the matching --cert-sig-scheme"
+        )
+
+
 class CheckpointRuleMismatch(ValueError):
     """A checkpoint written under one commit rule was offered to the
     other.  Deliberately NOT swallowed by the torn-checkpoint tolerance
@@ -186,6 +231,7 @@ class State:
             if len(bytes(name)) != 32:
                 raise ValueError("checkpoint: authority key must be 32 bytes")
             out += bytes(name) + struct.pack("<Q", round)
+        out += _scheme_trailer()
         return bytes(out)
 
     def restore(self, blob: bytes) -> None:
@@ -209,8 +255,7 @@ class State:
             raise ValueError("checkpoint: bad magic")
         (last_round,) = struct.unpack_from("<Q", blob, 6)
         (n,) = struct.unpack_from("<I", blob, 14)
-        if len(blob) != 18 + 40 * n:
-            raise ValueError("checkpoint: truncated or oversized blob")
+        _check_scheme_trailer(blob, 18 + 40 * n)
         entries = []
         pos = 18
         for _ in range(n):

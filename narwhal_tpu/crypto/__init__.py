@@ -14,26 +14,36 @@ loop per SURVEY.md §3.3).
 
 from .digest import Digest, digest32
 from .keys import KeyPair, PublicKey, SecretKey, Signature
+from .aggregate import (
+    AggregateSignature,
+    SchemeMismatch,
+    aggregate_votes,
+)
 from .service import SignatureService
 from .backend import (
     set_backend,
     get_backend,
     verify,
+    verify_aggregate,
     verify_batch,
     verify_batch_mask,
 )
 
 __all__ = [
+    "AggregateSignature",
     "Digest",
     "digest32",
     "KeyPair",
     "PublicKey",
+    "SchemeMismatch",
     "SecretKey",
     "Signature",
     "SignatureService",
+    "aggregate_votes",
     "set_backend",
     "get_backend",
     "verify",
+    "verify_aggregate",
     "verify_batch",
     "verify_batch_mask",
 ]

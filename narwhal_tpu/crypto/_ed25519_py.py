@@ -112,6 +112,44 @@ def _point_mul_base(s: int):
     return q
 
 
+def multi_scalar_mul(pairs):
+    """Σ sᵢ·Pᵢ via Straus interleaved 4-bit windows: one shared doubling
+    chain (≤ 256 doublings total) plus 14 precompute adds and ≤ 64
+    digit adds PER POINT — ~74·n + 256 point ops for n terms, vs the
+    ~400·n of independent `_point_mul` calls.  This is what makes the
+    half-aggregated certificate check (one equation over 2·q+1 points)
+    cheaper than q serial verifies even on the pure-Python fallback.
+
+    ``pairs`` is a sequence of ``(scalar, point)`` with points in
+    extended coordinates; scalars are taken mod nothing (callers reduce
+    mod L), non-positive scalars contribute the neutral element."""
+    live = [(s, p) for s, p in pairs if s > 0]
+    if not live:
+        return _NEUTRAL
+    tables = []
+    max_bits = 0
+    for s, p in live:
+        row = [None] * 16
+        row[1] = p
+        for j in range(2, 16):
+            row[j] = _point_add(row[j - 1], p)
+        tables.append(row)
+        if s.bit_length() > max_bits:
+            max_bits = s.bit_length()
+    q = _NEUTRAL
+    for i in range((max_bits + 3) // 4 - 1, -1, -1):
+        q = _point_add(q, q)
+        q = _point_add(q, q)
+        q = _point_add(q, q)
+        q = _point_add(q, q)
+        shift = 4 * i
+        for (s, _), row in zip(live, tables):
+            d = (s >> shift) & 15
+            if d:
+                q = _point_add(q, row[d])
+    return q
+
+
 def _point_equal(p, q) -> bool:
     # x1/z1 == x2/z2  and  y1/z1 == y2/z2, avoiding inversions.
     return (

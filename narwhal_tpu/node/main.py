@@ -144,6 +144,19 @@ def main(argv=None) -> int:
         "fails AT BOOT unless NARWHAL_CRYPTO_BACKEND_STRICT=0.",
     )
     run.add_argument(
+        "--cert-sig-scheme",
+        choices=["individual", "halfagg"],
+        default=None,
+        help="Certificate signature scheme: individual (2f+1 ed25519 "
+        "vote signatures per certificate, the default) or halfagg "
+        "(ed25519 half-aggregation: the quorum folds into ONE 32*(q+1)-"
+        "byte blob verified by a single multiexp equation — ~44%% fewer "
+        "certificate signature bytes and 1 verify op per certificate "
+        "instead of 2f+1).  Default: the NARWHAL_CERT_SIG_SCHEME env "
+        "knob, else individual.  Committee-wide — a cross-scheme frame "
+        "refuses at decode, a cross-scheme checkpoint refuses at boot.",
+    )
+    run.add_argument(
         "--commit-rule",
         choices=["classic", "lowdepth", "multileader"],
         default=None,
@@ -302,6 +315,15 @@ def main(argv=None) -> int:
 
     logging.getLogger("narwhal.node").info(
         "Commit rule: %s", resolve_commit_rule(args.commit_rule)
+    )
+    # Certificate-signature scheme: same precedence (CLI >
+    # NARWHAL_CERT_SIG_SCHEME > individual), pinned process-wide before
+    # any certificate is assembled or decoded; garbage raises here.
+    from ..crypto import aggregate as cert_sig
+
+    cert_sig.set_scheme(cert_sig.resolve_scheme(args.cert_sig_scheme))
+    logging.getLogger("narwhal.node").info(
+        "Certificate signature scheme: %s", cert_sig.scheme()
     )
 
     async def run_node() -> None:

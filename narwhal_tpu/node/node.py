@@ -223,10 +223,19 @@ async def _replay_persisted_certificates(
     the commit rule.  Values that are not certificates (headers fail the
     decode, payload markers are empty) are skipped; certificates at or
     below the restored frontier can never commit again (order_dag's ≥
-    skip) and are dropped here instead of costing queue slots."""
+    skip) and are dropped here instead of costing queue slots.
+
+    Certificates persisted under the OTHER cert-sig scheme refuse to
+    decode (SchemeMismatch); they are counted and reported in one loud
+    warning naming both schemes rather than silently skipped — the
+    consensus checkpoint refuses the cross-scheme boot outright, but a
+    checkpoint-less store must not quietly drop its history."""
+    from ..crypto import SchemeMismatch
     from ..primary.messages import Certificate
 
     certs = []
+    cross_scheme = 0
+    cross_scheme_detail = ""
     for i, value in enumerate(store.values()):
         if i % 256 == 0 and i:
             # The scan runs on the freshly booted node's event loop while
@@ -237,13 +246,25 @@ async def _replay_persisted_certificates(
             continue
         try:
             cert = Certificate.deserialize(value)
+        except SchemeMismatch as e:
+            cross_scheme += 1
+            cross_scheme_detail = str(e)
+            continue
         except Exception:
             continue  # a header or foreign record
-        if not cert.votes:
+        if not cert.votes and cert.agg is None:
             continue
         if cert.round <= state.last_committed.get(cert.origin, 0):
             continue
         certs.append(cert)
+    if cross_scheme:
+        metrics.counter("primary.invalid_signatures").inc(cross_scheme)
+        log.warning(
+            "Persisted store holds %d certificate(s) from the other "
+            "cert-sig scheme; they cannot re-enter consensus (%s)",
+            cross_scheme,
+            cross_scheme_detail,
+        )
     if not certs:
         return
     certs.sort(key=lambda c: c.round)

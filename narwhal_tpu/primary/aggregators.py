@@ -10,7 +10,8 @@ from __future__ import annotations
 from typing import List, Optional, Set
 
 from ..config import Committee
-from ..crypto import Digest, PublicKey
+from ..crypto import Digest, PublicKey, aggregate_votes
+from ..crypto.aggregate import scheme as cert_sig_scheme
 from .errors import AuthorityReuse
 from .messages import Certificate, Header, Vote
 
@@ -33,6 +34,18 @@ class VotesAggregator:
         self.weight += committee.stake(vote.author)
         if self.weight >= committee.quorum_threshold():
             self.weight = 0  # ensures quorum is only reached once
+            if cert_sig_scheme() == "halfagg":
+                # Fold the quorum into ONE aggregate at assembly time
+                # (ROADMAP item 2): every vote signed this certificate's
+                # digest, so the digest the aggregate binds is known
+                # before the votes are attached.
+                certificate = Certificate(header=header)
+                signers, agg = aggregate_votes(
+                    bytes(certificate.digest()), self.votes
+                )
+                certificate.agg_signers = signers
+                certificate.agg = agg
+                return certificate
             return Certificate(header=header, votes=list(self.votes))
         return None
 

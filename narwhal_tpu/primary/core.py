@@ -829,6 +829,13 @@ class Core:
                 for vn, vs in item[1].votes:
                     h.update(bytes(vn))
                     h.update(bytes(vs))
+                # halfagg: the signer list and aggregate blob are the
+                # signature material — same tamper argument as votes (a
+                # re-sent copy with a corrupted aggregate must MISS).
+                if item[1].agg is not None:
+                    for vn in item[1].agg_signers:
+                        h.update(bytes(vn))
+                    h.update(bytes(item[1].agg))
                 dedup_key = h.digest()
             # lint: allow-interleave(_handle_primaries_burst is single-flight by mode exclusivity: with the window off _verify_loop is never spawned and only run() calls it; with the window on run() forwards peer messages instead of handling them, so only _verify_loop calls it — the cache read→await→insert window is therefore never concurrent with another burst's insert)
             seen = dedup_key is not None and dedup_key in self._verified_recent

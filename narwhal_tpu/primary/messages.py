@@ -17,10 +17,20 @@ vmap target (SURVEY.md §3.3).
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Set, Tuple
+from typing import Dict, List, Optional, Set, Tuple
 
 from ..config import Committee, WorkerId
-from ..crypto import Digest, PublicKey, Signature, digest32, verify, verify_batch
+from ..crypto import (
+    AggregateSignature,
+    Digest,
+    PublicKey,
+    Signature,
+    digest32,
+    verify,
+    verify_aggregate,
+    verify_batch,
+)
+from ..crypto.aggregate import SCHEMES, SchemeMismatch, scheme as cert_sig_scheme
 from ..messages import Round, read_key_ref, skip_key_ref, write_key_ref
 from ..network import wirev2
 from ..utils.serde import Reader, Writer
@@ -223,10 +233,29 @@ class Vote:
 # --- Certificate -------------------------------------------------------------
 
 
+# Certificate wire scheme byte (first byte after the embedded header):
+# which certificate-signature scheme the votes section is encoded under.
+# Voteless (genesis) certificates always write 0 — they carry no
+# signature material, so they are scheme-neutral.  An unknown byte or a
+# scheme-bearing byte that differs from this process's scheme refuses
+# loudly at decode (SchemeMismatch — the checkpoint-magic pattern):
+# silently parsing the other scheme's bytes would misread signature
+# material, and a pre-scheme stored certificate misreads its vote count
+# as an unknown scheme byte, which is exactly the loud refusal we want.
+CERT_SCHEME_INDIVIDUAL = 0
+CERT_SCHEME_HALFAGG = 1
+
+
 @dataclass
 class Certificate:
     header: Header
     votes: List[Tuple[PublicKey, Signature]] = field(default_factory=list)
+    # --cert-sig-scheme halfagg: the sorted signer quorum plus ONE
+    # half-aggregated blob instead of 2f+1 (name, sig) pairs.  Exactly
+    # one of votes / (agg_signers, agg) is populated on a non-genesis
+    # certificate; genesis has neither.
+    agg_signers: List[PublicKey] = field(default_factory=list)
+    agg: Optional[AggregateSignature] = None
 
     @property
     def round(self) -> Round:
@@ -235,6 +264,19 @@ class Certificate:
     @property
     def origin(self) -> PublicKey:
         return self.header.author
+
+    @property
+    def scheme(self) -> str:
+        """The scheme this certificate's signature material is under
+        ("individual" for genesis: no material, scheme-neutral)."""
+        return "halfagg" if self.agg is not None else "individual"
+
+    def voters(self) -> List[PublicKey]:
+        """The authorities whose signatures back this certificate,
+        scheme-independent — the stake/reuse checks run over this."""
+        if self.agg is not None:
+            return list(self.agg_signers)
+        return [name for name, _ in self.votes]
 
     def digest(self) -> Digest:
         # Memoized: H(header_id ‖ round ‖ origin) never changes after
@@ -256,9 +298,20 @@ class Certificate:
         if self in genesis(committee):
             return
         self.header.verify_structure(committee)
+        if self.agg is not None and len(self.agg) != 32 * (
+            len(self.agg_signers) + 1
+        ):
+            # Signer list and blob width must agree BEFORE stake math: a
+            # blob carrying more commitments than named signers (or
+            # fewer) is malformed, not merely unverifiable.
+            raise InvalidSignature(
+                f"certificate {self.digest()!r}: aggregate width "
+                f"{len(self.agg)} does not match "
+                f"{len(self.agg_signers)} signers"
+            )
         weight = 0
         used = set()
-        for name, _ in self.votes:
+        for name in self.voters():
             if name in used:
                 raise AuthorityReuse(repr(name))
             stake = committee.stake(name)
@@ -270,8 +323,16 @@ class Certificate:
             raise CertificateRequiresQuorum(repr(self.digest()))
 
     def signature_claims(self) -> List[Tuple[bytes, PublicKey, Signature]]:
-        """Header signature + every vote signature over this certificate's
-        digest — 2f+2 claims joining the Core's accumulated device batch."""
+        """Header signature + this certificate's vote material over its
+        digest.  ``individual``: 2f+2 claims joining the Core's
+        accumulated device batch.  ``halfagg``: exactly TWO claims — the
+        header signature plus one aggregate claim (signer tuple +
+        AggregateSignature in the key/sig slots), which the backend seam
+        prices as ONE verify op at the ``certificate_agg`` site."""
+        if self.agg is not None:
+            return self.header.signature_claims() + [
+                (bytes(self.digest()), tuple(self.agg_signers), self.agg)
+            ]
         if not self.votes:  # genesis
             return []
         d = bytes(self.digest())
@@ -282,11 +343,18 @@ class Certificate:
     def verify(self, committee: Committee) -> None:
         """Quorum + batched signature check (reference messages.rs:189-215).
         The batched call is the #1 crypto hot loop — the TPU backend verifies
-        all 2f+1 signatures in one device dispatch."""
+        all 2f+1 signatures in one device dispatch; under ``halfagg`` the
+        whole quorum is ONE aggregate equation instead."""
         if self in genesis(committee):
             return
         self.verify_structure(committee)
         self.header.verify(committee)
+        if self.agg is not None:
+            if not verify_aggregate(
+                bytes(self.digest()), self.agg_signers, self.agg
+            ):
+                raise InvalidSignature(f"certificate {self.digest()!r}")
+            return
         if not verify_batch(
             self.digest(),
             [n for n, _ in self.votes],
@@ -297,9 +365,24 @@ class Certificate:
 
     def encode(self, w: Writer) -> None:
         self.header.encode(w)
-        # v2: vote pubkeys ride as committee indices — ~1 byte instead
-        # of 32 per vote.  The 64-byte signatures remain; collapsing
-        # those is ROADMAP item 4 (aggregate certificates).
+        # Scheme-versioned votes section (CERT_SCHEME_* rationale above).
+        # individual/v2: vote pubkeys ride as committee indices — ~1 byte
+        # instead of 32 per vote, 64-byte signatures remain.  halfagg:
+        # the signer refs plus ONE 32·(q+1) aggregate blob (length
+        # implied by the signer count) — the ROADMAP item 2 collapse.
+        if self.agg is not None:
+            w.u8(CERT_SCHEME_HALFAGG)
+            if wirev2.enabled():
+                w.uvarint(len(self.agg_signers))
+                for name in self.agg_signers:
+                    write_key_ref(w, name)
+            else:
+                w.u32(len(self.agg_signers))
+                for name in self.agg_signers:
+                    w.raw(name)
+            w.raw(self.agg)
+            return
+        w.u8(CERT_SCHEME_INDIVIDUAL)
         if wirev2.enabled():
             w.uvarint(len(self.votes))
             for name, sig in self.votes:
@@ -314,6 +397,32 @@ class Certificate:
     @classmethod
     def decode(cls, r: Reader) -> "Certificate":
         header = Header.decode(r)
+        scheme_byte = r.u8()
+        if scheme_byte not in (CERT_SCHEME_INDIVIDUAL, CERT_SCHEME_HALFAGG):
+            raise ValueError(
+                f"unknown certificate scheme byte {scheme_byte} (known "
+                f"schemes: {SCHEMES}; a pre-scheme store must be wiped or "
+                "replayed by the version that wrote it)"
+            )
+        ours = cert_sig_scheme()
+        if scheme_byte == CERT_SCHEME_HALFAGG:
+            if ours != "halfagg":
+                raise SchemeMismatch(
+                    "certificate was encoded under cert-sig scheme "
+                    f"'halfagg' but this node runs {ours!r}; refusing to "
+                    "decode — run the whole committee (and its stores) "
+                    "under one --cert-sig-scheme"
+                )
+            if wirev2.enabled():
+                n = r.uvarint()
+                signers = [read_key_ref(r) for _ in range(n)]
+            else:
+                n = r.u32()
+                signers = [PublicKey(r.raw(32)) for _ in range(n)]
+            if n == 0:
+                raise ValueError("halfagg certificate with zero signers")
+            agg = AggregateSignature(r.raw(32 * (n + 1)))
+            return cls(header, agg_signers=signers, agg=agg)
         votes = []
         if wirev2.enabled():
             for _ in range(r.uvarint()):
@@ -321,6 +430,13 @@ class Certificate:
         else:
             for _ in range(r.u32()):
                 votes.append((PublicKey(r.raw(32)), Signature(r.raw(64))))
+        if votes and ours != "individual":
+            raise SchemeMismatch(
+                "certificate carries individually-signed votes but this "
+                f"node runs cert-sig scheme {ours!r}; refusing to decode "
+                "— run the whole committee (and its stores) under one "
+                "--cert-sig-scheme"
+            )
         return cls(header, votes)
 
     def serialize(self) -> bytes:
@@ -375,6 +491,11 @@ class Certificate:
             and self.round == other.round
             and self.origin == other.origin
             and self.votes == other.votes
+            # Aggregate material participates for the same reason votes
+            # do: a forged voteless-but-aggregated certificate must not
+            # compare equal to genesis and skip verification.
+            and self.agg_signers == other.agg_signers
+            and self.agg == other.agg
         )
 
 
@@ -565,9 +686,16 @@ def _certificate_spans(data: bytes) -> List[int]:
     r.u8()
     spans: List[int] = []
     _header_body_spans(r, spans)
-    for _ in range(r.uvarint()):  # votes
-        skip_key_ref(r, spans)
-        r.raw(64)
+    scheme_byte = r.u8()
+    if scheme_byte == CERT_SCHEME_HALFAGG:
+        n = r.uvarint()
+        for _ in range(n):  # signer refs
+            skip_key_ref(r, spans)
+        r.raw(32 * (n + 1))  # aggregate blob: nonces never repeat
+    else:
+        for _ in range(r.uvarint()):  # votes
+            skip_key_ref(r, spans)
+            r.raw(64)
     return spans
 
 

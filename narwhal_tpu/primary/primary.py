@@ -15,7 +15,7 @@ from typing import List
 
 from .. import metrics
 from ..config import Committee, Parameters
-from ..crypto import KeyPair, SignatureService
+from ..crypto import KeyPair, SchemeMismatch, SignatureService
 from ..messages import (
     WORKER_PRIMARY_FRAME_TYPES,
     decode_worker_primary_message,
@@ -53,6 +53,16 @@ class PrimaryReceiverHandler:
     async def dispatch(self, writer: Writer, message: bytes) -> None:
         try:
             decoded = decode_primary_message(message)
+        except SchemeMismatch as e:
+            # A certificate from a peer running the OTHER cert-sig
+            # scheme: counted into primary.invalid_signatures (its
+            # signature material is unreadable here, which is what the
+            # invalid_signature health rule should fire on), named
+            # loudly — a mixed committee is an operator error, not
+            # line noise.
+            metrics.counter("primary.invalid_signatures").inc()
+            log.warning("Dropping cross-scheme primary message: %s", e)
+            return
         except ValueError as e:
             log.warning("Dropping malformed primary message: %s", e)
             return

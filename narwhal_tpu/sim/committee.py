@@ -562,6 +562,21 @@ def run_sim_scenario(
     net_seam.install(transport)
     set_sim_mac(True)
     set_decode_cache(True)
+    # Certificate-signature scheme per scenario (the sim arm of the
+    # --cert-sig-scheme A/B): a NARWHAL_CERT_SIG_SCHEME entry in the
+    # scenario's env dict scopes the scheme to this run; absent, the
+    # harness/process setting stands.  Saved/restored like the sim-MAC
+    # bracket so sweeps with mixed arms can't leak a scheme.
+    from ..crypto.aggregate import (
+        resolve_scheme as _resolve_cert_scheme,
+        scheme_override as _cert_scheme_override,
+        set_scheme as _set_cert_scheme,
+    )
+
+    prev_cert_scheme = _cert_scheme_override()
+    scenario_scheme = scenario.env.get("NARWHAL_CERT_SIG_SCHEME")
+    if scenario_scheme is not None:
+        _set_cert_scheme(_resolve_cert_scheme(str(scenario_scheme)))
     timed_out = False
     try:
         try:
@@ -583,6 +598,7 @@ def run_sim_scenario(
     finally:
         set_sim_mac(False)
         set_decode_cache(False)
+        _set_cert_scheme(prev_cert_scheme)
         set_wall_base(None)
         net_seam.reset()
         reg.health = None

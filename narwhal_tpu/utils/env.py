@@ -125,6 +125,21 @@ _VARS = [
         "replay; checkpoints refuse a cross-rule restore.",
     ),
     EnvVar(
+        "NARWHAL_CERT_SIG_SCHEME", "str", "individual",
+        "Certificate signature scheme (equivalent of `node run "
+        "--cert-sig-scheme`): `individual` (2f+1 ed25519 vote "
+        "signatures per certificate) or `halfagg` (ed25519 "
+        "half-aggregation — the vote quorum folds into one 32*(q+1)-"
+        "byte blob at assembly and sanitization verifies ONE multiexp "
+        "equation per certificate, at the `certificate_agg` crypto "
+        "site). Committee-wide: a certificate frame from the other "
+        "scheme refuses at decode (counted into "
+        "primary.invalid_signatures) and a consensus checkpoint "
+        "written under one scheme refuses to restore under the other. "
+        "Default individual — the flip is gated on the ISSUE 20 "
+        "measurement ladder (benchmark/trajectory_gate.json).",
+    ),
+    EnvVar(
         "NARWHAL_CHANNEL_CAPACITY", "int", 1_000,
         "Bounded-queue capacity for every inter-task channel "
         "(node/primary/worker planes; the quorum admission window keeps "

@@ -1,0 +1,665 @@
+"""Certificate signature schemes (ISSUE 20): ed25519 half-aggregation
+behind the crypto backend seam.
+
+Three layers of protection are pinned here:
+
+1. The DIFFERENTIAL one-sided gate (the ISSUE 14 shape): the ``halfagg``
+   verifier must NEVER accept signature material the ``individual``
+   serial path rejects — rogue-key substitution, wrong subsets,
+   duplicate signers, truncated/bit-flipped aggregates, below-quorum
+   signer sets.  A forgery slipping in only under the fast scheme would
+   be a consensus-split machine.
+2. The SCHEME SEAM: scheme-versioned Certificate wire frames (both wire
+   formats), loud ``SchemeMismatch`` refusal in every direction — frame
+   decode, checkpoint restore (tusk + all three golden oracles),
+   persisted-store replay — each counted into
+   ``primary.invalid_signatures`` where a live node sees it.
+3. The LEDGER invariants: exactly TWO signature claims per halfagg
+   certificate, ONE ``certificate_agg`` verify op per certificate, and
+   the PR 12 verified-digest cache absorbing re-deliveries with ZERO
+   new verify ops (a tampered re-delivery must MISS the cache).
+"""
+
+import asyncio
+import contextlib
+import random
+
+import pytest
+
+from narwhal_tpu import metrics
+from narwhal_tpu.crypto import KeyPair, PublicKey, Signature
+from narwhal_tpu.crypto import aggregate as agg_mod
+from narwhal_tpu.crypto.aggregate import (
+    AggregateSignature,
+    SchemeMismatch,
+    aggregate_votes,
+    cert_sig_wire_bytes,
+    resolve_scheme,
+    verify_halfagg,
+)
+from narwhal_tpu.crypto.keys import cpu_verify, set_sim_mac, sim_mac_enabled
+from narwhal_tpu.messages import set_wire_committee
+from narwhal_tpu.network import wirev2
+from narwhal_tpu.primary.errors import InvalidSignature
+from narwhal_tpu.primary.messages import Certificate, genesis
+from tests.common import committee, keys, make_header, make_votes
+
+rng = random.Random(20)
+
+
+def run(coro, timeout=30):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+def cnt(name: str) -> float:
+    c = metrics.registry().counters.get(name)
+    return c.value if c is not None else 0
+
+
+@contextlib.contextmanager
+def scheme(name):
+    """Scope a cert-sig scheme override, restoring any outer override."""
+    prev = agg_mod.scheme_override()
+    agg_mod.set_scheme(name)
+    try:
+        yield
+    finally:
+        agg_mod.set_scheme(prev)
+
+
+@contextlib.contextmanager
+def wire_committee(c):
+    """Install the wire-v2 key-index roster, restoring the previous one
+    (set_wire_committee has no uninstall — node boot owns it)."""
+    from narwhal_tpu import messages as wire_messages
+
+    prev_keys = wire_messages._WIRE_KEYS
+    prev_index = wire_messages._WIRE_INDEX
+    set_wire_committee(c)
+    try:
+        yield
+    finally:
+        wire_messages._WIRE_KEYS = prev_keys
+        wire_messages._WIRE_INDEX = prev_index
+
+
+@contextlib.contextmanager
+def v2_wire():
+    wirev2.set_enabled(True)
+    try:
+        yield
+    finally:
+        wirev2.set_enabled(None)
+
+
+@contextlib.contextmanager
+def v1_wire():
+    wirev2.set_enabled(False)
+    try:
+        yield
+    finally:
+        wirev2.set_enabled(None)
+
+
+def quorum_votes(n=5, seed=3, msg=None):
+    """n distinct keypairs voting over one 32-byte digest."""
+    import hashlib
+
+    msg = msg or hashlib.sha256(b"scheme-test-%d" % seed).digest()
+    kps = [
+        KeyPair.generate(hashlib.sha256(b"q%d:%d" % (seed, i)).digest())
+        for i in range(n)
+    ]
+    from narwhal_tpu.crypto.digest import Digest
+
+    votes = [(kp.name, kp.sign(Digest(msg))) for kp in kps]
+    return msg, kps, votes
+
+
+def make_agg_certificate(header, exclude_author=True):
+    """The halfagg analog of tests.common.make_certificate: fold the
+    3-vote quorum into one aggregate at assembly time."""
+    cert = Certificate(header=header)
+    votes = [
+        (v.author, v.signature)
+        for v in make_votes(header, exclude_author=exclude_author)
+    ]
+    signers, agg = aggregate_votes(bytes(cert.digest()), votes)
+    cert.agg_signers = signers
+    cert.agg = agg
+    return cert
+
+
+# --- the aggregation core ----------------------------------------------------
+
+
+def test_aggregate_roundtrip_and_order_independence():
+    """A valid quorum aggregates to one verifying blob, and the blob is
+    a pure function of the vote SET (arrival order folded away by the
+    canonical signer sort) — two nodes assembling from differently
+    ordered bursts produce byte-identical certificates."""
+    msg, kps, votes = quorum_votes(7)
+    signers, agg = aggregate_votes(msg, votes)
+    assert isinstance(agg, AggregateSignature)
+    assert agg.n_signers == 7 and len(agg) == 32 * 8
+    assert signers == sorted(signers, key=bytes)
+    assert verify_halfagg(msg, [bytes(s) for s in signers], agg)
+    shuffled = list(votes)
+    rng.shuffle(shuffled)
+    signers2, agg2 = aggregate_votes(msg, shuffled)
+    assert signers2 == signers and bytes(agg2) == bytes(agg)
+
+
+def test_duplicate_signer_rejected_at_both_seams():
+    msg, kps, votes = quorum_votes(4)
+    with pytest.raises(ValueError, match="duplicate"):
+        aggregate_votes(msg, votes + [votes[0]])
+    signers, agg = aggregate_votes(msg, votes)
+    publics = [bytes(s) for s in signers]
+    dup = publics[:-1] + [publics[0]]
+    assert verify_halfagg(msg, dup, agg) is False
+
+
+def test_structure_hostility_is_invalid_never_a_crash():
+    """Truncated / padded / widened blobs and non-canonical scalars are
+    False (or unrepresentable at the type seam), never an exception."""
+    msg, kps, votes = quorum_votes(5)
+    signers, agg = aggregate_votes(msg, votes)
+    publics = [bytes(s) for s in signers]
+    assert verify_halfagg(msg, publics, bytes(agg)[:-32]) is False
+    assert verify_halfagg(msg, publics, bytes(agg) + bytes(32)) is False
+    assert verify_halfagg(msg, publics[:-1], agg) is False  # wrong width
+    assert verify_halfagg(msg, [], b"") is False
+    # s̄ >= L is non-canonical: forced rejection, not wraparound.
+    big = bytes(agg)[:-32] + (agg_mod._L + 1).to_bytes(32, "little")
+    assert verify_halfagg(msg, publics, big) is False
+    # The typed seam refuses impossible widths outright.
+    for bad in (b"", bytes(32), bytes(65)):
+        with pytest.raises(ValueError):
+            AggregateSignature(bad)
+
+
+def test_differential_one_sided_gate():
+    """The frozen differential battery: for every mutation, assemble the
+    aggregate FROM the mutated votes and compare verdicts — the halfagg
+    path must never accept a vote set the individual serial path
+    rejects.  (The reverse — individual accepts, halfagg rejects — is
+    safe and expected for aggregate-only corruptions.)"""
+    msg, kps, votes = quorum_votes(6, seed=9)
+    cases = [("clean", list(votes))]
+    # Bit-flipped scalar half of one vote.
+    flipped = list(votes)
+    s = bytearray(bytes(flipped[2][1]))
+    s[40] ^= 1
+    flipped[2] = (flipped[2][0], Signature(bytes(s)))
+    cases.append(("bitflip-s", flipped))
+    # Bit-flipped nonce commitment of one vote.
+    flipped_r = list(votes)
+    s = bytearray(bytes(flipped_r[1][1]))
+    s[3] ^= 0x80
+    flipped_r[1] = (flipped_r[1][0], Signature(bytes(s)))
+    cases.append(("bitflip-r", flipped_r))
+    # A signature transplanted from another key (rogue substitution).
+    swapped = list(votes)
+    swapped[0] = (swapped[0][0], votes[1][1])
+    cases.append(("transplanted-sig", swapped))
+    # A vote over the WRONG message smuggled into the set.
+    other_msg, _, other_votes = quorum_votes(6, seed=10)
+    mixed = list(votes)
+    mixed[3] = (mixed[3][0], other_votes[3][1])
+    cases.append(("wrong-message-vote", mixed))
+    for name, vset in cases:
+        individual = all(cpu_verify(msg, k, s) for k, s in vset)
+        try:
+            signers, agg = aggregate_votes(msg, vset)
+            halfagg = verify_halfagg(
+                msg, [bytes(x) for x in signers], agg
+            )
+        except ValueError:
+            halfagg = False
+        if halfagg:
+            assert individual, (
+                f"{name}: halfagg accepted a vote set the serial "
+                "path rejects"
+            )
+        if name == "clean":
+            assert halfagg and individual
+        else:
+            assert not halfagg, f"{name}: corrupted set must not verify"
+
+
+def test_rogue_key_cannot_ride_an_aggregate():
+    """A victim key that never signed cannot be named in the signer list
+    of any aggregate an attacker can produce: the coefficients bind the
+    full (message, keys, commitments) transcript, so substituting or
+    appending a key invalidates the equation."""
+    msg, kps, votes = quorum_votes(5, seed=4)
+    victim = KeyPair.generate(bytes([99]) * 32)
+    signers, agg = aggregate_votes(msg, votes)
+    publics = [bytes(s) for s in signers]
+    # Substitute the victim for a genuine signer.
+    for i in range(len(publics)):
+        subst = list(publics)
+        subst[i] = bytes(victim.name)
+        assert verify_halfagg(msg, subst, agg) is False
+    # Claiming a DIFFERENT genuine subset fails too.
+    rotated = publics[1:] + publics[:1]
+    assert verify_halfagg(msg, rotated, agg) is False
+
+
+def test_sim_mac_aggregate_is_wire_exact_and_still_rejects_forgery():
+    """Sim-MAC mode (the deterministic committee sim): the aggregate
+    analog keeps the exact 32·(n+1) wire width, verifies genuine MACs,
+    and still rejects a forged vote MAC."""
+    assert not sim_mac_enabled()
+    set_sim_mac(True)
+    try:
+        msg, kps, votes = quorum_votes(5, seed=6)
+        signers, agg = aggregate_votes(msg, votes)
+        publics = [bytes(s) for s in signers]
+        assert len(agg) == 32 * 6
+        assert verify_halfagg(msg, publics, agg)
+        forged = list(votes)
+        forged[0] = (forged[0][0], Signature(bytes(64)))
+        s2, agg2 = aggregate_votes(msg, forged)
+        assert verify_halfagg(msg, [bytes(x) for x in s2], agg2) is False
+        flip = bytearray(agg)
+        flip[-1] ^= 1  # the closing binder
+        assert verify_halfagg(msg, publics, bytes(flip)) is False
+    finally:
+        set_sim_mac(False)
+
+
+def test_cert_sig_wire_bytes_formula():
+    """The exact numbers the bench summary and the README table quote."""
+    assert cert_sig_wire_bytes("individual", 14, 2) == 14 * 65 + 64  # 974
+    assert cert_sig_wire_bytes("halfagg", 14, 2) == 14 + 480 + 64  # 558
+    assert cert_sig_wire_bytes("individual", 14, 1) == 14 * 96 + 64
+    assert cert_sig_wire_bytes("halfagg", 14, 1) == 14 * 32 + 480 + 64
+    assert cert_sig_wire_bytes("individual", 3, 2) == 259
+    assert cert_sig_wire_bytes("halfagg", 3, 2) == 195
+    assert cert_sig_wire_bytes("individual", 34, 2) == 2274
+    assert cert_sig_wire_bytes("halfagg", 34, 2) == 1218
+    with pytest.raises(ValueError):
+        cert_sig_wire_bytes("bls", 14)
+
+
+def test_resolve_scheme_and_gauge(monkeypatch):
+    assert resolve_scheme() == "individual"
+    assert resolve_scheme("halfagg") == "halfagg"
+    monkeypatch.setenv("NARWHAL_CERT_SIG_SCHEME", "halfagg")
+    assert resolve_scheme() == "halfagg"
+    with pytest.raises(ValueError, match="unknown cert-sig scheme"):
+        resolve_scheme("bls")
+    with pytest.raises(ValueError):
+        agg_mod.set_scheme("garbage")
+    gauge = metrics.registry().gauge_fns["crypto.cert_sig_scheme"]
+    with scheme("halfagg"):
+        assert gauge() == 1.0
+    with scheme("individual"):
+        assert gauge() == 0.0
+
+
+# --- Certificate integration -------------------------------------------------
+
+
+def test_halfagg_certificate_verifies_and_prices_one_op():
+    """End-to-end through Certificate.verify: a halfagg certificate
+    verifies with exactly ONE ``certificate_agg`` verify op (the
+    2f+1 → 1 collapse), exactly TWO signature claims, and a tampered
+    aggregate raises InvalidSignature."""
+    c = committee()
+    with scheme("halfagg"):
+        cert = make_agg_certificate(make_header(keys()[1], c=c))
+        assert cert.scheme == "halfagg"
+        assert len(cert.signature_claims()) == 2
+        before = cnt("crypto.verify.ops.certificate_agg")
+        cert.verify(c)
+        assert cnt("crypto.verify.ops.certificate_agg") == before + 1
+        # Tampered aggregate: rejected, still one op (the equation ran).
+        bad = Certificate(
+            header=cert.header,
+            agg_signers=list(cert.agg_signers),
+            agg=AggregateSignature(
+                bytes(cert.agg)[:-32] + bytes(32)
+            ),
+        )
+        with pytest.raises(InvalidSignature):
+            bad.verify(c)
+        # Signer/blob width mismatch fails structure BEFORE stake math.
+        torn = Certificate(
+            header=cert.header,
+            agg_signers=list(cert.agg_signers)[:-1],
+            agg=cert.agg,
+        )
+        with pytest.raises(InvalidSignature, match="aggregate width"):
+            torn.verify_structure(c)
+        # Below-quorum signer sets refuse at structure too.
+        sub_signers = list(cert.agg_signers)[:1]
+        _, sub_agg = aggregate_votes(
+            bytes(cert.digest()),
+            [(cert.agg_signers[0], Signature(bytes(64)))],
+        )
+        from narwhal_tpu.primary.errors import CertificateRequiresQuorum
+
+        below = Certificate(
+            header=cert.header, agg_signers=sub_signers, agg=sub_agg
+        )
+        with pytest.raises(CertificateRequiresQuorum):
+            below.verify_structure(c)
+
+
+def test_votes_aggregator_assembles_halfagg_certificate():
+    """The VotesAggregator's quorum trip emits an aggregate certificate
+    under halfagg — no (name, sig) pairs on the wire object at all."""
+    from narwhal_tpu.primary.aggregators import VotesAggregator
+
+    c = committee()
+    header = make_header(keys()[0], c=c)
+    votes = make_votes(header)
+    with scheme("halfagg"):
+        aggr = VotesAggregator()
+        cert = None
+        for v in votes:
+            cert = aggr.append(v, c, header) or cert
+        assert cert is not None
+        assert cert.votes == [] and cert.agg is not None
+        assert len(cert.agg_signers) == 3
+        cert.verify(c)
+    with scheme("individual"):
+        aggr = VotesAggregator()
+        cert = None
+        for v in votes:
+            cert = aggr.append(v, c, header) or cert
+        assert cert is not None and cert.agg is None
+        assert len(cert.votes) == 3
+
+
+def test_wire_roundtrip_both_schemes_both_formats():
+    """Scheme-versioned Certificate serialization round-trips under each
+    scheme × each wire format, and genesis (voteless, scheme-neutral)
+    round-trips under BOTH schemes."""
+    c = committee()
+    with wire_committee(c):
+        for wire_ctx in (v1_wire, v2_wire):
+            with wire_ctx():
+                with scheme("individual"):
+                    from tests.common import make_certificate
+
+                    cert = make_certificate(make_header(keys()[1], c=c))
+                    rt = Certificate.deserialize(cert.serialize())
+                    assert rt == cert and rt.scheme == "individual"
+                with scheme("halfagg"):
+                    acert = make_agg_certificate(
+                        make_header(keys()[2], c=c)
+                    )
+                    rt = Certificate.deserialize(acert.serialize())
+                    assert rt == acert and rt.scheme == "halfagg"
+                    assert rt.agg_signers == acert.agg_signers
+                    assert bytes(rt.agg) == bytes(acert.agg)
+                for sch in ("individual", "halfagg"):
+                    with scheme(sch):
+                        g = genesis(c)[0]
+                        blob = Certificate(header=g.header).serialize()
+                        rt = Certificate.deserialize(blob)
+                        assert rt.votes == [] and rt.agg is None
+
+
+def test_cross_scheme_frames_refuse_loudly():
+    """A halfagg frame at an individual node (and vice versa) raises
+    SchemeMismatch naming the schemes; an unknown scheme byte (the
+    pre-scheme-store shape) is a loud ValueError."""
+    c = committee()
+    with wire_committee(c):
+        from tests.common import make_certificate
+
+        with scheme("halfagg"):
+            agg_blob = make_agg_certificate(
+                make_header(keys()[1], c=c)
+            ).serialize()
+        with scheme("individual"):
+            ind_blob = make_certificate(
+                make_header(keys()[2], c=c)
+            ).serialize()
+        with scheme("individual"):
+            with pytest.raises(SchemeMismatch, match="halfagg"):
+                Certificate.deserialize(agg_blob)
+        with scheme("halfagg"):
+            with pytest.raises(SchemeMismatch, match="halfagg"):
+                Certificate.deserialize(ind_blob)
+        # Unknown scheme byte: find the scheme byte (first byte after
+        # the embedded header) by re-encoding the header alone.
+        from narwhal_tpu.utils.serde import Writer
+
+        with scheme("individual"):
+            cert = make_certificate(make_header(keys()[3], c=c))
+            w = Writer()
+            cert.header.encode(w)
+            off = len(w.finish())
+            blob = cert.serialize()
+            assert blob[off] == 0
+            mangled = blob[:off] + bytes([7]) + blob[off + 1:]
+            with pytest.raises(ValueError, match="scheme byte 7"):
+                Certificate.deserialize(mangled)
+
+
+def test_receiver_counts_cross_scheme_certificate():
+    """The PrimaryReceiverHandler seam: a halfagg certificate frame
+    arriving at an individual node is dropped, counted into
+    ``primary.invalid_signatures`` (where the invalid_signature health
+    rule watches), and never ACKed or enqueued."""
+
+    async def go():
+        from narwhal_tpu.primary.messages import encode_primary_message
+        from narwhal_tpu.primary.primary import PrimaryReceiverHandler
+
+        c = committee()
+        with wire_committee(c):
+            with scheme("halfagg"):
+                frame = encode_primary_message(
+                    make_agg_certificate(make_header(keys()[1], c=c))
+                )
+            sent = []
+
+            class W:
+                async def send(self, b):
+                    sent.append(b)
+
+            tx_p, tx_h = asyncio.Queue(), asyncio.Queue()
+            handler = PrimaryReceiverHandler(tx_p, tx_h)
+            with scheme("individual"):
+                before = cnt("primary.invalid_signatures")
+                await handler.dispatch(W(), frame)
+                assert cnt("primary.invalid_signatures") == before + 1
+            assert sent == [] and tx_p.empty() and tx_h.empty()
+
+    run(go())
+
+
+def test_verify_cache_absorbs_halfagg_redelivery_with_zero_new_ops():
+    """The PR 12 invariant re-asserted under halfagg: a re-delivered
+    aggregate certificate rides the verified-digest cache — ZERO new
+    ``certificate_agg`` verify ops — while a re-sent copy whose
+    aggregate was tampered MISSES the cache (the dedup key covers the
+    signer list and blob) and is re-verified and rejected."""
+
+    async def go():
+        import sys
+
+        sys.path.insert(0, "tests")
+        from test_core import make_core
+
+        c = committee()
+        me = keys()[0]
+        with scheme("halfagg"):
+            core, store, qs = make_core(c, me)
+            cert = make_agg_certificate(make_header(keys()[2], c=c))
+            seen = []
+
+            async def recording(source, item, sig_ok):
+                seen.append(sig_ok)
+
+            core._handle = recording
+            try:
+                before = cnt("crypto.verify.ops.certificate_agg")
+                hits0 = core._m_verify_cache_hits.value
+
+                await core._handle_primaries_burst(
+                    [("certificate", cert)]
+                )
+                assert cnt("crypto.verify.ops.certificate_agg") == before + 1
+                await core._handle_primaries_burst(
+                    [("certificate", cert)]
+                )
+                # Re-delivery: cache hit, zero new aggregate verifies.
+                assert cnt("crypto.verify.ops.certificate_agg") == before + 1
+                assert core._m_verify_cache_hits.value == hits0 + 1
+                assert seen == [True, True]
+
+                tampered = Certificate(
+                    header=cert.header,
+                    agg_signers=list(cert.agg_signers),
+                    agg=AggregateSignature(
+                        bytes(cert.agg)[:-32] + bytes(32)
+                    ),
+                )
+                assert tampered.digest() == cert.digest()
+                await core._handle_primaries_burst(
+                    [("certificate", tampered)]
+                )
+                assert (
+                    cnt("crypto.verify.ops.certificate_agg") == before + 2
+                )
+                assert seen[-1] is False
+                # The genuine copy still rides the cache afterwards.
+                await core._handle_primaries_burst(
+                    [("certificate", cert)]
+                )
+                assert (
+                    cnt("crypto.verify.ops.certificate_agg") == before + 2
+                )
+                assert seen[-1] is True
+            finally:
+                core.network.close()
+
+    run(go())
+
+
+# --- checkpoint + store seams ------------------------------------------------
+
+
+def _state_classes():
+    from narwhal_tpu.consensus.golden import GoldenTusk
+    from narwhal_tpu.consensus.golden_lowdepth import GoldenLowDepthTusk
+    from narwhal_tpu.consensus.golden_multileader import (
+        GoldenMultiLeaderTusk,
+    )
+    from narwhal_tpu.consensus.tusk import Tusk
+
+    c = committee()
+    return [
+        ("tusk", lambda: Tusk(c, gc_depth=50, fixed_coin=True).state),
+        (
+            "golden",
+            lambda: GoldenTusk(c, gc_depth=50, fixed_coin=True).state,
+        ),
+        (
+            "golden_lowdepth",
+            lambda: GoldenLowDepthTusk(
+                c, gc_depth=50, fixed_coin=True
+            ).state,
+        ),
+        (
+            "golden_multileader",
+            lambda: GoldenMultiLeaderTusk(
+                c, gc_depth=50, fixed_coin=True
+            ).state,
+        ),
+    ]
+
+
+def test_checkpoint_scheme_trailer_all_rules():
+    """Checkpoint blobs carry a scheme trailer: same-scheme restores
+    round-trip, cross-scheme restores raise SchemeMismatch naming BOTH
+    schemes in BOTH directions, legacy (trailer-less) blobs read as
+    individual, and a torn trailer is a loud ValueError — for the tusk
+    State and all three golden oracles."""
+    for label, mk in _state_classes():
+        with scheme("individual"):
+            blob_ind = mk().snapshot_bytes()
+        with scheme("halfagg"):
+            blob_agg = mk().snapshot_bytes()
+        # Same-scheme round-trips.
+        with scheme("individual"):
+            mk().restore(blob_ind)
+        with scheme("halfagg"):
+            mk().restore(blob_agg)
+        # Cross-scheme refusals, both directions, both names present.
+        with scheme("individual"):
+            with pytest.raises(SchemeMismatch) as e:
+                mk().restore(blob_agg)
+            assert "halfagg" in str(e.value), label
+            assert "individual" in str(e.value), label
+        with scheme("halfagg"):
+            with pytest.raises(SchemeMismatch) as e:
+                mk().restore(blob_ind)
+            assert "halfagg" in str(e.value), label
+            assert "individual" in str(e.value), label
+        # Legacy (pre-scheme) blob: implicit individual.
+        legacy = blob_ind[:-5]
+        with scheme("individual"):
+            mk().restore(legacy)
+        with scheme("halfagg"):
+            with pytest.raises(SchemeMismatch):
+                mk().restore(legacy)
+        # Torn trailer: neither body-only nor body+5.
+        with scheme("individual"):
+            with pytest.raises(ValueError):
+                mk().restore(blob_ind[:-2])
+
+
+def test_store_replay_roundtrips_each_scheme_and_counts_cross():
+    """_replay_persisted_certificates under each scheme feeds the
+    persisted certificates back to consensus; a store written under the
+    OTHER scheme replays nothing and counts every refused certificate
+    into ``primary.invalid_signatures``."""
+
+    async def go():
+        from narwhal_tpu.consensus.tusk import Tusk
+        from narwhal_tpu.node.node import _replay_persisted_certificates
+        from narwhal_tpu.store import Store
+        from tests.common import make_certificate
+
+        c = committee()
+        with wire_committee(c):
+            for sch, mk in (
+                ("individual", lambda h: make_certificate(h)),
+                ("halfagg", lambda h: make_agg_certificate(h)),
+            ):
+                with scheme(sch):
+                    store = Store()
+                    cert = mk(make_header(keys()[1], c=c))
+                    store.write(bytes(cert.digest()), cert.serialize())
+                    state = Tusk(c, gc_depth=50, fixed_coin=True).state
+                    q = asyncio.Queue()
+                    await _replay_persisted_certificates(store, state, q)
+                    assert q.qsize() == 1
+                    replayed = q.get_nowait()
+                    assert replayed.digest() == cert.digest()
+                    assert replayed.scheme == sch
+
+            # Cross-scheme store: written under halfagg, booted under
+            # individual — refused, counted, loudly not silently.
+            with scheme("halfagg"):
+                store = Store()
+                cert = make_agg_certificate(make_header(keys()[2], c=c))
+                store.write(bytes(cert.digest()), cert.serialize())
+            with scheme("individual"):
+                state = Tusk(c, gc_depth=50, fixed_coin=True).state
+                q = asyncio.Queue()
+                before = cnt("primary.invalid_signatures")
+                await _replay_persisted_certificates(store, state, q)
+                assert q.qsize() == 0
+                assert cnt("primary.invalid_signatures") == before + 1
+
+    run(go())
